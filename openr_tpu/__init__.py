@@ -1,0 +1,22 @@
+"""openr_tpu — a TPU-native link-state routing framework.
+
+A from-scratch rebuild of the capabilities of Open/R (Meta's interior routing
+platform, reference: /root/reference) designed TPU-first:
+
+- The route-computation core (reference: openr/decision/) is a batched JAX/XLA
+  compute engine: all-sources SPF as a vmapped frontier-relaxation SSSP kernel
+  over a device-resident CSR topology tensor, with jitted ECMP/KSP next-hop
+  extraction (openr_tpu.ops).
+- The surrounding distributed machinery — neighbor discovery (spark), link
+  monitoring, the replicated CRDT key-value store (kvstore), route origination
+  (prefix_manager), FIB programming (fib), control API (ctrl) and operator CLI
+  (cli) — is functionally equivalent to the reference but rebuilt on an
+  asyncio-per-thread module runtime (openr_tpu.runtime) mirroring the
+  reference's OpenrEventBase/queue architecture (openr/common/OpenrEventBase.h,
+  openr/messaging/).
+- Multi-chip scale-out (openr_tpu.parallel) shards the SSSP source batch and
+  edge set over a jax.sharding.Mesh, replacing the reference's per-node
+  replicated computation with sharded computation over ICI.
+"""
+
+__version__ = "0.1.0"

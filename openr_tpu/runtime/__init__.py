@@ -1,0 +1,13 @@
+from .queue import QueueClosedError, ReplicateQueue, RQueue, RWQueue
+from .eventbase import OpenrEventBase
+from .async_util import AsyncDebounce, AsyncThrottle
+
+__all__ = [
+    "QueueClosedError",
+    "RWQueue",
+    "RQueue",
+    "ReplicateQueue",
+    "OpenrEventBase",
+    "AsyncDebounce",
+    "AsyncThrottle",
+]

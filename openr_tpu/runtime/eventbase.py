@@ -1,0 +1,184 @@
+"""Module runtime: one thread + one asyncio event loop per module.
+
+Functional equivalent of the reference's OpenrEventBase
+(openr/common/OpenrEventBase.h:28) — every framework module extends this and
+runs in its own thread (reference: startEventBase, openr/Main.cpp:132-163).
+Fibers become asyncio tasks; timers become loop timers; the health timestamp
+feeds the Watchdog exactly like getTimestamp() (OpenrEventBase.h:74).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import logging
+import threading
+import time
+from typing import Any, Awaitable, Callable, Coroutine, Optional
+
+log = logging.getLogger(__name__)
+
+
+class OpenrEventBase:
+    def __init__(self, name: str = "") -> None:
+        self.name = name or type(self).__name__
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+        self._stopped = threading.Event()
+        self._tasks: set[asyncio.Task] = set()
+        self._timestamp = time.monotonic()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def run(self) -> None:
+        """Start the module thread and event loop; returns once running."""
+        if self._thread is not None:
+            raise RuntimeError(f"{self.name} already started")
+        self._thread = threading.Thread(target=self._thread_main, name=self.name)
+        self._thread.daemon = True
+        self._thread.start()
+        self._started.wait()
+
+    def _thread_main(self) -> None:
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+        try:
+            try:
+                loop.call_soon(self._started.set)
+                self._track(
+                    loop.create_task(self._heartbeat(), name=f"{self.name}-heartbeat")
+                )
+                init = getattr(self, "prepare", None)
+                if init is not None:
+                    task = loop.create_task(init(), name=f"{self.name}-prepare")
+                    self._track(task)
+            finally:
+                # never leave run() parked on _started if startup raised
+                self._started.set()
+            loop.run_forever()
+            # drain: cancel outstanding tasks
+            for task in list(self._tasks):
+                task.cancel()
+            pending = [t for t in self._tasks if not t.done()]
+            if pending:
+                loop.run_until_complete(
+                    asyncio.gather(*pending, return_exceptions=True)
+                )
+        finally:
+            loop.close()
+            self._stopped.set()
+
+    async def _heartbeat(self) -> None:
+        while True:
+            self._timestamp = time.monotonic()
+            await asyncio.sleep(0.1)
+
+    def stop(self) -> None:
+        """Stop the loop and join the thread (callable from any thread)."""
+        if self._loop is None:
+            return
+        stopping = getattr(self, "stopping", None)
+
+        def _do_stop() -> None:
+            async def _graceful():
+                if stopping is not None:
+                    try:
+                        await stopping()
+                    except Exception:
+                        log.exception("%s: stopping() hook failed", self.name)
+                self._loop.stop()
+
+            self._loop.create_task(_graceful())
+
+        try:
+            self._loop.call_soon_threadsafe(_do_stop)
+        except RuntimeError:
+            return
+        # Joining from the module's own loop thread would deadlock (the loop
+        # must keep running to execute _do_stop); the stop is then async.
+        if threading.current_thread() is not self._thread:
+            self.wait_until_stopped()
+
+    def wait_until_running(self, timeout: Optional[float] = None) -> bool:
+        return self._started.wait(timeout)
+
+    def wait_until_stopped(self, timeout: Optional[float] = None) -> bool:
+        ok = self._stopped.wait(timeout)
+        if ok and self._thread is not None:
+            self._thread.join()
+        return ok
+
+    @property
+    def is_running(self) -> bool:
+        return self._started.is_set() and not self._stopped.is_set()
+
+    # -- task / timer API (reference: addFiberTask :47, scheduleTimeout) ----
+
+    def _track(self, task: asyncio.Task) -> None:
+        self._tasks.add(task)
+
+        def _done(t: asyncio.Task) -> None:
+            self._tasks.discard(t)
+            if not t.cancelled():
+                exc = t.exception()
+                if exc is not None and not isinstance(exc, asyncio.CancelledError):
+                    log.exception(
+                        "%s: task %s crashed", self.name, t.get_name(), exc_info=exc
+                    )
+
+        task.add_done_callback(_done)
+
+    def add_fiber_task(self, coro: Coroutine[Any, Any, Any], name: str = "") -> None:
+        """Schedule a long-running coroutine on this module's loop (from any
+        thread). Reference: addFiberTask, OpenrEventBase.h:47."""
+        assert self._loop is not None, f"{self.name} not started"
+
+        def _create() -> None:
+            self._track(self._loop.create_task(coro, name=name or "fiber"))
+
+        self._loop.call_soon_threadsafe(_create)
+
+    def run_in_event_base_thread(
+        self, fn: Callable[[], Any]
+    ) -> "concurrent.futures.Future[Any]":
+        """Marshal a call onto this module's thread and return a future for
+        the result.  Reference pattern: runInEventBaseThread + SemiFuture
+        (openr/decision/Decision.cpp:1513) — the cross-thread RPC mechanism."""
+        assert self._loop is not None, f"{self.name} not started"
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def _call() -> None:
+            if not fut.set_running_or_notify_cancel():
+                return
+            try:
+                fut.set_result(fn())
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        self._loop.call_soon_threadsafe(_call)
+        return fut
+
+    async def run_async(self, coro: Awaitable[Any]) -> Any:
+        """Await a coroutine on this module's loop from another loop/thread."""
+        assert self._loop is not None
+        cfut = asyncio.run_coroutine_threadsafe(coro, self._loop)
+        return await asyncio.wrap_future(cfut)
+
+    def run_coroutine(self, coro: Awaitable[Any]) -> "concurrent.futures.Future[Any]":
+        assert self._loop is not None
+        return asyncio.run_coroutine_threadsafe(coro, self._loop)
+
+    def schedule_timeout(
+        self, delay_s: float, fn: Callable[[], Any]
+    ) -> None:
+        assert self._loop is not None
+        self._loop.call_soon_threadsafe(
+            lambda: self._loop.call_later(delay_s, fn)
+        )
+
+    # -- watchdog interface (reference: getTimestamp, OpenrEventBase.h:74) --
+
+    def get_timestamp(self) -> float:
+        return self._timestamp

@@ -1,0 +1,203 @@
+"""Inter-module message queues.
+
+Functional equivalent of the reference's messaging layer
+(openr/messaging/Queue.h:36-129, openr/messaging/ReplicateQueue.h:23):
+
+- RWQueue — unbounded MPMC blocking queue; sync get() suspends the calling
+  thread, async aget() suspends the calling asyncio task (the stand-in for the
+  reference's fiber suspension).
+- RQueue — read-only view handed to consumers.
+- ReplicateQueue — single writer fans out to N per-reader queues; readers are
+  created on demand and each sees every message pushed after creation.
+
+Thread-safety: push/get may be called from any thread; aget() from any event
+loop.  Async waiters are woken via call_soon_threadsafe and re-try the pop, so
+no item is ever reserved for a waiter that got cancelled.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from collections import deque
+from typing import Any, Generic, Iterable, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class QueueClosedError(RuntimeError):
+    pass
+
+
+class RQueue(Generic[T]):
+    """Read interface (reference: RQueue openr/messaging/Queue.h:36)."""
+
+    def __init__(self, impl: "RWQueue[T]") -> None:
+        self._impl = impl
+
+    def get(self, timeout: Optional[float] = None) -> T:
+        return self._impl.get(timeout)
+
+    async def aget(self) -> T:
+        return await self._impl.aget()
+
+    def try_get(self) -> Optional[T]:
+        return self._impl.try_get()
+
+    def size(self) -> int:
+        return self._impl.size()
+
+    def is_closed(self) -> bool:
+        return self._impl.is_closed()
+
+
+class RWQueue(Generic[T]):
+    def __init__(self) -> None:
+        self._items: deque[T] = deque()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._closed = False
+        self._async_waiters: list[tuple[asyncio.AbstractEventLoop, asyncio.Future]] = []
+        self._num_pushed = 0
+        self._num_read = 0
+
+    # -- write side ---------------------------------------------------------
+
+    def push(self, item: T) -> bool:
+        with self._lock:
+            if self._closed:
+                return False
+            self._items.append(item)
+            self._num_pushed += 1
+            self._cond.notify()
+            waiters, self._async_waiters = self._async_waiters, []
+        self._wake(waiters)
+        return True
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+            waiters, self._async_waiters = self._async_waiters, []
+        self._wake(waiters)
+
+    @staticmethod
+    def _wake(waiters: Iterable[tuple[asyncio.AbstractEventLoop, asyncio.Future]]) -> None:
+        for loop, fut in waiters:
+            try:
+                loop.call_soon_threadsafe(
+                    lambda f=fut: f.done() or f.set_result(None)
+                )
+            except RuntimeError:
+                pass  # loop already closed
+
+    # -- read side ----------------------------------------------------------
+
+    def get(self, timeout: Optional[float] = None) -> T:
+        with self._cond:
+            if not self._cond.wait_for(
+                lambda: self._items or self._closed, timeout=timeout
+            ):
+                raise TimeoutError("queue get timed out")
+            if self._items:
+                self._num_read += 1
+                return self._items.popleft()
+            raise QueueClosedError("queue closed")
+
+    def try_get(self) -> Optional[T]:
+        with self._lock:
+            if self._items:
+                self._num_read += 1
+                return self._items.popleft()
+            if self._closed:
+                raise QueueClosedError("queue closed")
+            return None
+
+    async def aget(self) -> T:
+        while True:
+            loop = asyncio.get_running_loop()
+            with self._lock:
+                if self._items:
+                    self._num_read += 1
+                    return self._items.popleft()
+                if self._closed:
+                    raise QueueClosedError("queue closed")
+                fut: asyncio.Future = loop.create_future()
+                self._async_waiters.append((loop, fut))
+            try:
+                await fut
+            except asyncio.CancelledError:
+                with self._lock:
+                    self._async_waiters = [
+                        (l, f) for (l, f) in self._async_waiters if f is not fut
+                    ]
+                raise
+
+    # -- introspection ------------------------------------------------------
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def is_closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    def get_reader(self) -> RQueue[T]:
+        return RQueue(self)
+
+    def stats(self) -> dict[str, int]:
+        with self._lock:
+            return {
+                "size": len(self._items),
+                "num_pushed": self._num_pushed,
+                "num_read": self._num_read,
+            }
+
+
+class ReplicateQueue(Generic[T]):
+    """One writer, N reader queues (reference:
+    openr/messaging/ReplicateQueue.h:23)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._readers: list[RWQueue[T]] = []
+        self._closed = False
+        self._num_writes = 0
+
+    def push(self, item: T) -> bool:
+        with self._lock:
+            if self._closed:
+                return False
+            readers = list(self._readers)
+            self._num_writes += 1
+        for q in readers:
+            q.push(item)
+        return True
+
+    def get_reader(self) -> RQueue[T]:
+        with self._lock:
+            if self._closed:
+                raise QueueClosedError("replicate queue closed")
+            q: RWQueue[T] = RWQueue()
+            self._readers.append(q)
+            return RQueue(q)
+
+    def get_num_readers(self) -> int:
+        with self._lock:
+            return len(self._readers)
+
+    def get_num_writes(self) -> int:
+        with self._lock:
+            return self._num_writes
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            readers = list(self._readers)
+        for q in readers:
+            q.close()

@@ -1,0 +1,98 @@
+"""Canonical byte serialization for wire types.
+
+The reference serializes thrift structs to binary for KvStore values
+(openr/kvstore/KvStore.cpp mergeKeyValues compares raw value bytes as a CRDT
+tie-break).  We need the same property — a deterministic, byte-stable encoding
+— so two stores serializing the same logical object always produce identical
+bytes.  Canonical JSON (sorted keys, no whitespace, explicit defaults) gives
+us that plus debuggability.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+from typing import Any, Type, TypeVar
+
+from . import types as T
+
+T_ = TypeVar("T_")
+
+
+def _to_jsonable(obj: Any) -> Any:
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, bytes):
+        return {"__bytes__": obj.hex()}
+    if isinstance(obj, enum.Enum):
+        return int(obj.value)
+    if dataclasses.is_dataclass(obj):
+        return {
+            f.name: _to_jsonable(getattr(obj, f.name))
+            for f in dataclasses.fields(obj)
+        }
+    if isinstance(obj, dict):
+        return {str(k): _to_jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_to_jsonable(v) for v in obj]
+    raise TypeError(f"cannot serialize {type(obj)!r}")
+
+
+def _from_jsonable(cls: Any, data: Any) -> Any:
+    if data is None:
+        return None
+    if isinstance(data, dict) and "__bytes__" in data:
+        return bytes.fromhex(data["__bytes__"])
+    origin = getattr(cls, "__origin__", None)
+    if origin is not None:
+        args = cls.__args__
+        if origin is dict:
+            return {k: _from_jsonable(args[1], v) for k, v in data.items()}
+        if origin is list:
+            return [_from_jsonable(args[0], v) for v in data]
+        if origin is tuple:
+            elem = args[0] if args else Any
+            return tuple(_from_jsonable(elem, v) for v in data)
+        # Optional[X] / unions: try each member
+        for arg in args:
+            if arg is type(None):
+                continue
+            try:
+                return _from_jsonable(arg, data)
+            except (TypeError, ValueError, KeyError):
+                continue
+        return data
+    if isinstance(cls, type) and issubclass(cls, enum.Enum):
+        return cls(data)
+    if dataclasses.is_dataclass(cls):
+        import typing
+
+        hints = typing.get_type_hints(cls)
+        kwargs = {}
+        for f in dataclasses.fields(cls):
+            if f.name in data:
+                kwargs[f.name] = _from_jsonable(hints[f.name], data[f.name])
+        return cls(**kwargs)
+    return data
+
+
+def dumps(obj: Any) -> bytes:
+    """Serialize a wire-type dataclass to canonical bytes."""
+    payload = {"__type__": type(obj).__name__, "d": _to_jsonable(obj)}
+    return json.dumps(payload, sort_keys=True, separators=(",", ":")).encode()
+
+
+_TYPE_REGISTRY: dict[str, type] = {
+    name: getattr(T, name)
+    for name in dir(T)
+    if dataclasses.is_dataclass(getattr(T, name, None))
+}
+
+
+def loads(data: bytes, expected: Type[T_] | None = None) -> T_:
+    payload = json.loads(data.decode())
+    cls = _TYPE_REGISTRY[payload["__type__"]]
+    if expected is not None and cls is not expected:
+        raise TypeError(f"expected {expected.__name__}, got {payload['__type__']}")
+    return _from_jsonable(cls, payload["d"])

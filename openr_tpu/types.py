@@ -1,0 +1,375 @@
+"""Wire types for openr_tpu.
+
+Functional equivalents of the reference Thrift IDL (reference:
+openr/if/Types.thrift, openr/if/Network.thrift) as slotted dataclasses with a
+canonical byte serialization (see openr_tpu.serializer).  String node ids live
+at this layer; the Decision compute plane interns them to dense int32 ids
+before anything touches the device.
+"""
+
+from __future__ import annotations
+
+import enum
+import ipaddress
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+# ---------------------------------------------------------------------------
+# Perf events (reference: openr/if/Types.thrift:29-52, openr/common/Util.h:134)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(slots=True)
+class PerfEvent:
+    node_name: str
+    event_name: str
+    unix_ts_ms: int
+
+
+@dataclass(slots=True)
+class PerfEvents:
+    events: list[PerfEvent] = field(default_factory=list)
+
+    def add(self, node_name: str, event_name: str, ts_ms: Optional[int] = None) -> None:
+        ts = ts_ms if ts_ms is not None else int(time.time() * 1000)
+        self.events.append(PerfEvent(node_name, event_name, ts))
+
+    def total_duration_ms(self) -> int:
+        if len(self.events) < 2:
+            return 0
+        return self.events[-1].unix_ts_ms - self.events[0].unix_ts_ms
+
+    def duration_between_ms(self, start_event: str, end_event: str) -> int:
+        """Reference: getDurationBetweenPerfEvents, openr/common/Util.h:147."""
+        start = next(e for e in self.events if e.event_name == start_event)
+        end = next(e for e in self.events if e.event_name == end_event)
+        if end.unix_ts_ms < start.unix_ts_ms:
+            raise ValueError(f"{end_event} precedes {start_event}")
+        return end.unix_ts_ms - start.unix_ts_ms
+
+
+def add_perf_event(perf_events: Optional[PerfEvents], node: str, event: str) -> None:
+    if perf_events is not None:
+        perf_events.add(node, event)
+
+
+# ---------------------------------------------------------------------------
+# Adjacency / link state (reference: openr/if/Types.thrift:96-175)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(slots=True)
+class Adjacency:
+    other_node_name: str
+    if_name: str
+    metric: int = 1
+    adj_label: int = 0
+    is_overloaded: bool = False
+    rtt_us: int = 0
+    timestamp_s: int = 0
+    weight: int = 1
+    other_if_name: str = ""
+    next_hop_v6: str = ""
+    next_hop_v4: str = ""
+
+
+@dataclass(slots=True)
+class AdjacencyDatabase:
+    this_node_name: str
+    adjacencies: list[Adjacency] = field(default_factory=list)
+    is_overloaded: bool = False
+    node_label: int = 0
+    area: str = "0"
+    perf_events: Optional[PerfEvents] = None
+
+
+# ---------------------------------------------------------------------------
+# Prefixes (reference: openr/if/Types.thrift:200-420, OpenrConfig.thrift)
+# ---------------------------------------------------------------------------
+
+
+class PrefixType(enum.IntEnum):
+    LOOPBACK = 1
+    DEFAULT = 2
+    BGP = 3
+    PREFIX_ALLOCATOR = 4
+    BREEZE = 5
+    RIB = 6
+    CONFIG = 7
+    VIP = 8
+
+
+class PrefixForwardingType(enum.IntEnum):
+    IP = 0
+    SR_MPLS = 1
+
+
+class PrefixForwardingAlgorithm(enum.IntEnum):
+    SP_ECMP = 0
+    KSP2_ED_ECMP = 1
+
+
+@dataclass(slots=True)
+class PrefixMetrics:
+    """Reference: openr/if/OpenrConfig.thrift PrefixMetrics — ordered
+    comparison chain for best-route selection (higher is better for
+    preferences, lower is better for distance)."""
+
+    version: int = 1
+    path_preference: int = 1000
+    source_preference: int = 100
+    distance: int = 0
+
+
+@dataclass(slots=True)
+class PrefixEntry:
+    prefix: str  # CIDR string, canonicalized
+    type: PrefixType = PrefixType.LOOPBACK
+    forwarding_type: PrefixForwardingType = PrefixForwardingType.IP
+    forwarding_algorithm: PrefixForwardingAlgorithm = PrefixForwardingAlgorithm.SP_ECMP
+    metrics: PrefixMetrics = field(default_factory=PrefixMetrics)
+    tags: tuple[str, ...] = ()
+    area_stack: tuple[str, ...] = ()
+    min_nexthop: Optional[int] = None
+    prepend_label: Optional[int] = None
+    # BGP-style metric vector comparison is expressed through `metrics`;
+    # the reference's separate MetricVector path (Decision.cpp:865) collapses
+    # into the same ordered-tuple compare here.
+
+
+@dataclass(slots=True)
+class PrefixDatabase:
+    this_node_name: str
+    prefix_entries: list[PrefixEntry] = field(default_factory=list)
+    delete_prefix: bool = False
+    area: str = "0"
+    perf_events: Optional[PerfEvents] = None
+
+
+# ---------------------------------------------------------------------------
+# KvStore (reference: openr/if/Types.thrift:555-1000)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(slots=True)
+class Value:
+    """Versioned CRDT value (reference: openr/if/Types.thrift:555).
+
+    `value is None` encodes a version-only advertisement (TTL refresh /
+    anti-entropy digest), exactly like an unset thrift optional binary.
+    """
+
+    version: int
+    originator_id: str
+    value: Optional[bytes] = None
+    ttl_ms: int = -1  # -1 == infinity (Constants::kTtlInfinity)
+    ttl_version: int = 0
+    hash: Optional[int] = None
+
+
+@dataclass(slots=True)
+class Publication:
+    key_vals: dict[str, Value] = field(default_factory=dict)
+    expired_keys: list[str] = field(default_factory=list)
+    node_ids: Optional[list[str]] = None
+    tobe_updated_keys: Optional[list[str]] = None
+    area: str = "0"
+
+
+class KvStorePeerState(enum.IntEnum):
+    """Reference: openr/kvstore/KvStore.h:278 peer FSM."""
+
+    IDLE = 0
+    SYNCING = 1
+    INITIALIZED = 2
+
+
+@dataclass(slots=True)
+class PeerSpec:
+    peer_addr: str = ""
+    ctrl_port: int = 0
+    state: KvStorePeerState = KvStorePeerState.IDLE
+
+
+@dataclass(slots=True)
+class PeerEvent:
+    area: str = "0"
+    peers_to_add: dict[str, PeerSpec] = field(default_factory=dict)
+    peers_to_del: list[str] = field(default_factory=list)
+
+
+@dataclass(slots=True)
+class KvStoreSyncEvent:
+    node_name: str
+    area: str
+
+
+# ---------------------------------------------------------------------------
+# Spark neighbor discovery messages
+# (reference: openr/if/Types.thrift:1276-1384)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(slots=True)
+class SparkHelloMsg:
+    domain_name: str
+    node_name: str
+    if_name: str
+    seq_num: int
+    neighbor_infos: dict[str, "ReflectedNeighborInfo"] = field(default_factory=dict)
+    version: int = 1
+    solicit_response: bool = False
+    restarting: bool = False
+    sent_ts_us: int = 0
+
+
+@dataclass(slots=True)
+class ReflectedNeighborInfo:
+    last_nbr_msg_sent_ts_us: int = 0
+    last_my_msg_rcvd_ts_us: int = 0
+
+
+@dataclass(slots=True)
+class SparkHandshakeMsg:
+    node_name: str
+    is_adjacency_established: bool
+    hold_time_ms: int
+    gr_hold_time_ms: int
+    transport_addr_v6: str
+    transport_addr_v4: str
+    openr_ctrl_port: int
+    area: str = "0"
+    neighbor_node_name: Optional[str] = None
+
+
+@dataclass(slots=True)
+class SparkHeartbeatMsg:
+    node_name: str
+    seq_num: int
+    hold_time_ms: int = 0
+
+
+class NeighborEventType(enum.IntEnum):
+    NEIGHBOR_UP = 1
+    NEIGHBOR_DOWN = 2
+    NEIGHBOR_RESTARTED = 3
+    NEIGHBOR_RTT_CHANGE = 4
+    NEIGHBOR_RESTARTING = 5
+    NEIGHBOR_ADJ_SYNCED = 6
+
+
+@dataclass(slots=True)
+class NeighborEvent:
+    event_type: NeighborEventType
+    node_name: str
+    if_name: str
+    area: str = "0"
+    neighbor_addr_v6: str = ""
+    neighbor_addr_v4: str = ""
+    ctrl_port: int = 0
+    rtt_us: int = 0
+    kvstore_port: int = 0
+    adj_only_used_by_other_node: bool = False
+
+
+# ---------------------------------------------------------------------------
+# Interfaces (reference: openr/if/Types.thrift:1100-1150)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(slots=True)
+class InterfaceInfo:
+    if_name: str
+    is_up: bool
+    if_index: int
+    networks: list[str] = field(default_factory=list)
+
+
+@dataclass(slots=True)
+class InterfaceDatabase:
+    this_node_name: str
+    interfaces: dict[str, InterfaceInfo] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# Routes (reference: openr/if/Network.thrift:66-160)
+# ---------------------------------------------------------------------------
+
+
+class MplsActionCode(enum.IntEnum):
+    PUSH = 0
+    SWAP = 1
+    PHP = 2  # Penultimate hop popping => POP_AND_LOOKUP for last hop
+    POP_AND_LOOKUP = 3
+
+
+@dataclass(slots=True, frozen=True)
+class MplsAction:
+    action: MplsActionCode
+    swap_label: Optional[int] = None
+    push_labels: Optional[tuple[int, ...]] = None
+
+
+@dataclass(slots=True, frozen=True)
+class NextHop:
+    """Reference: NextHopThrift openr/if/Network.thrift:66."""
+
+    address: str
+    if_name: Optional[str] = None
+    metric: int = 0
+    weight: int = 0
+    area: Optional[str] = None
+    neighbor_node_name: Optional[str] = None
+    mpls_action: Optional[MplsAction] = None
+
+
+@dataclass(slots=True)
+class UnicastRoute:
+    dest: str
+    next_hops: list[NextHop] = field(default_factory=list)
+
+
+@dataclass(slots=True)
+class MplsRoute:
+    top_label: int
+    next_hops: list[NextHop] = field(default_factory=list)
+
+
+@dataclass(slots=True)
+class RouteDatabase:
+    this_node_name: str
+    unicast_routes: list[UnicastRoute] = field(default_factory=list)
+    mpls_routes: list[MplsRoute] = field(default_factory=list)
+    perf_events: Optional[PerfEvents] = None
+
+
+# ---------------------------------------------------------------------------
+# Helpers
+# ---------------------------------------------------------------------------
+
+
+def normalize_prefix(prefix: str) -> str:
+    """Canonicalize a CIDR string (reference relies on thrift IpPrefix binary
+    form being canonical; we rely on the ipaddress module)."""
+    return str(ipaddress.ip_network(prefix, strict=False))
+
+
+def prefix_key(node: str, prefix: str, area: str) -> str:
+    """KvStore key for a prefix advertisement.
+
+    Reference: Constants::kPrefixDbMarker + PrefixKey format
+    (openr/common/Constants.h:212, openr/common/Util.h).
+    """
+    return f"prefix:[{node}]:[{area}]:[{normalize_prefix(prefix)}]"
+
+
+def adj_key(node: str) -> str:
+    """Reference: Constants::kAdjDbMarker (openr/common/Constants.h:209)."""
+    return f"adj:{node}"
+
+
+ADJ_MARKER = "adj:"
+PREFIX_MARKER = "prefix:"
+TTL_INFINITY = -1

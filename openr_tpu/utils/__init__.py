@@ -1,0 +1,4 @@
+from .backoff import ExponentialBackoff
+from .step_detector import StepDetector
+
+__all__ = ["ExponentialBackoff", "StepDetector"]

@@ -1,0 +1,60 @@
+"""Exponential backoff (reference: openr/common/ExponentialBackoff.{h,cpp}:22).
+
+Same semantics as the reference: starts at initial on first error, doubles on
+each further error, caps at max; report_success() resets unconditionally;
+with is_abort_at_max, a further error while already at max raises (the
+reference calls ::abort() there so the supervisor restarts the process —
+raising is the in-process equivalent, callers may escalate)."""
+
+from __future__ import annotations
+
+import time
+
+
+class MaxBackoffAbortError(RuntimeError):
+    """Raised on report_error() at max backoff when is_abort_at_max is set."""
+
+
+class ExponentialBackoff:
+    def __init__(
+        self,
+        initial_backoff_s: float,
+        max_backoff_s: float,
+        is_abort_at_max: bool = False,
+        clock=time.monotonic,
+    ) -> None:
+        if initial_backoff_s <= 0 or max_backoff_s <= initial_backoff_s:
+            raise ValueError("invalid backoff bounds")
+        self._initial = initial_backoff_s
+        self._max = max_backoff_s
+        self._is_abort_at_max = is_abort_at_max
+        self._clock = clock
+        self._current = 0.0
+        self._last_error_time = float("-inf")
+
+    def report_success(self) -> None:
+        self._last_error_time = float("-inf")
+        self._current = 0.0
+
+    def report_error(self) -> None:
+        if self._current >= self._max and self._is_abort_at_max:
+            raise MaxBackoffAbortError(
+                f"max backoff {self._max}s reached with abort-at-max set"
+            )
+        self._last_error_time = self._clock()
+        if self._current == 0.0:
+            self._current = self._initial
+        else:
+            self._current = min(self._current * 2, self._max)
+
+    def can_try_now(self) -> bool:
+        return self.get_time_remaining_until_retry() <= 0
+
+    def get_time_remaining_until_retry(self) -> float:
+        return max(0.0, (self._last_error_time + self._current) - self._clock())
+
+    def at_max_backoff(self) -> bool:
+        return self._current >= self._max
+
+    def get_current_backoff(self) -> float:
+        return self._current

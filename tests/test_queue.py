@@ -1,0 +1,161 @@
+"""Queue tests (modeled on the reference's
+openr/messaging/tests/QueueTest.cpp and ReplicateQueueTest.cpp)."""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from openr_tpu.runtime import (
+    QueueClosedError,
+    ReplicateQueue,
+    RWQueue,
+)
+
+
+def test_fifo_order():
+    q = RWQueue()
+    for i in range(100):
+        assert q.push(i)
+    assert q.size() == 100
+    assert [q.get() for _ in range(100)] == list(range(100))
+
+
+def test_try_get():
+    q = RWQueue()
+    assert q.try_get() is None
+    q.push("x")
+    assert q.try_get() == "x"
+    q.close()
+    with pytest.raises(QueueClosedError):
+        q.try_get()
+
+
+def test_blocking_get_across_threads():
+    q = RWQueue()
+    out = []
+
+    def reader():
+        out.append(q.get(timeout=5))
+
+    t = threading.Thread(target=reader)
+    t.start()
+    time.sleep(0.05)
+    q.push(42)
+    t.join(timeout=5)
+    assert out == [42]
+
+
+def test_get_timeout():
+    q = RWQueue()
+    with pytest.raises(TimeoutError):
+        q.get(timeout=0.01)
+
+
+def test_close_unblocks_getters():
+    q = RWQueue()
+    errs = []
+
+    def reader():
+        try:
+            q.get(timeout=5)
+        except QueueClosedError as e:
+            errs.append(e)
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)
+    q.close()
+    for t in threads:
+        t.join(timeout=5)
+    assert len(errs) == 4
+    assert not q.push(1)
+
+
+def test_async_get():
+    q = RWQueue()
+
+    async def main():
+        async def reader():
+            return await q.aget()
+
+        task = asyncio.create_task(reader())
+        await asyncio.sleep(0.01)
+        # push from another thread while the task is suspended
+        threading.Thread(target=lambda: q.push("hello")).start()
+        return await asyncio.wait_for(task, timeout=5)
+
+    assert asyncio.run(main()) == "hello"
+
+
+def test_async_get_closed():
+    q = RWQueue()
+
+    async def main():
+        async def reader():
+            with pytest.raises(QueueClosedError):
+                await q.aget()
+
+        task = asyncio.create_task(reader())
+        await asyncio.sleep(0.01)
+        q.close()
+        await asyncio.wait_for(task, timeout=5)
+
+    asyncio.run(main())
+
+
+def test_mpmc_stress():
+    q = RWQueue()
+    n_producers, n_consumers, per_producer = 4, 4, 500
+    consumed = []
+    lock = threading.Lock()
+
+    def producer(pid):
+        for i in range(per_producer):
+            q.push((pid, i))
+
+    def consumer():
+        while True:
+            try:
+                item = q.get(timeout=5)
+            except QueueClosedError:
+                return
+            with lock:
+                consumed.append(item)
+
+    cons = [threading.Thread(target=consumer) for _ in range(n_consumers)]
+    prods = [threading.Thread(target=producer, args=(i,)) for i in range(n_producers)]
+    for t in cons + prods:
+        t.start()
+    for t in prods:
+        t.join()
+    while q.size() > 0:
+        time.sleep(0.01)
+    q.close()
+    for t in cons:
+        t.join(timeout=5)
+    assert len(consumed) == n_producers * per_producer
+    # per-producer order preserved
+    for pid in range(n_producers):
+        seq = [i for (p, i) in consumed if p == pid]
+        assert seq == sorted(seq)
+
+
+def test_replicate_queue_fanout():
+    rq = ReplicateQueue()
+    r1 = rq.get_reader()
+    rq.push(1)  # only r1 sees this
+    r2 = rq.get_reader()
+    rq.push(2)
+    assert rq.get_num_readers() == 2
+    assert rq.get_num_writes() == 2
+    assert r1.get(timeout=1) == 1
+    assert r1.get(timeout=1) == 2
+    assert r2.get(timeout=1) == 2
+    rq.close()
+    with pytest.raises(QueueClosedError):
+        r1.get(timeout=1)
+    with pytest.raises(QueueClosedError):
+        rq.get_reader()

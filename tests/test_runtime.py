@@ -1,0 +1,182 @@
+"""OpenrEventBase / debounce / throttle / backoff / step-detector tests
+(modeled on openr/common/tests/UtilTest.cpp + OpenrEventBase usage)."""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from openr_tpu.runtime import (
+    AsyncDebounce,
+    AsyncThrottle,
+    OpenrEventBase,
+    RWQueue,
+)
+from openr_tpu.utils import ExponentialBackoff, StepDetector
+
+
+def test_eventbase_lifecycle():
+    evb = OpenrEventBase("test")
+    evb.run()
+    assert evb.wait_until_running(2)
+    assert evb.is_running
+    got = evb.run_in_event_base_thread(lambda: threading.current_thread().name)
+    assert got.result(timeout=2) == "test"
+    evb.stop()
+    assert evb.wait_until_stopped(2)
+    assert not evb.is_running
+
+
+def test_eventbase_fiber_task_queue_read():
+    evb = OpenrEventBase("reader")
+    q = RWQueue()
+    seen = []
+    done = threading.Event()
+
+    async def reader():
+        while True:
+            item = await q.aget()
+            seen.append(item)
+            if len(seen) == 3:
+                done.set()
+
+    evb.run()
+    evb.add_fiber_task(reader())
+    for i in range(3):
+        q.push(i)
+    assert done.wait(5)
+    assert seen == [0, 1, 2]
+    evb.stop()
+
+
+def test_eventbase_timestamp_advances():
+    evb = OpenrEventBase("hb")
+    evb.run()
+    t0 = evb.get_timestamp()
+    time.sleep(0.3)
+    assert evb.get_timestamp() > t0
+    evb.stop()
+
+
+def test_debounce_coalesces():
+    fires = []
+
+    async def main():
+        deb = AsyncDebounce(0.02, 0.1, lambda: fires.append(time.monotonic()))
+        t0 = time.monotonic()
+        for _ in range(5):
+            deb()
+            await asyncio.sleep(0.005)
+        await asyncio.sleep(0.3)
+        return t0
+
+    t0 = asyncio.run(main())
+    assert len(fires) == 1
+    # fired no earlier than min, no later than max (+slack)
+    assert 0.015 <= fires[0] - t0 <= 0.2
+
+
+def test_debounce_max_bound():
+    """A continuous stream of invocations must still fire by backoff_max."""
+    fires = []
+
+    async def main():
+        deb = AsyncDebounce(0.01, 0.05, lambda: fires.append(time.monotonic()))
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 0.2:
+            deb()
+            await asyncio.sleep(0.002)
+        await asyncio.sleep(0.1)
+
+    asyncio.run(main())
+    assert len(fires) >= 2  # kept firing despite constant invocation
+
+
+def test_throttle():
+    fires = []
+
+    async def main():
+        thr = AsyncThrottle(0.02, lambda: fires.append(1))
+        for _ in range(10):
+            thr()
+        await asyncio.sleep(0.05)
+        thr()
+        await asyncio.sleep(0.05)
+
+    asyncio.run(main())
+    assert len(fires) == 2
+
+
+def test_exponential_backoff():
+    now = [0.0]
+    bo = ExponentialBackoff(1.0, 8.0, clock=lambda: now[0])
+    assert bo.can_try_now()
+    bo.report_error()
+    assert not bo.can_try_now()
+    assert bo.get_current_backoff() == 1.0
+    bo.report_error()
+    assert bo.get_current_backoff() == 2.0
+    for _ in range(5):
+        bo.report_error()
+    assert bo.get_current_backoff() == 8.0
+    assert bo.at_max_backoff()
+    now[0] += 8.0
+    assert bo.can_try_now()
+    # success resets unconditionally (reference ExponentialBackoff.cpp:41-45)
+    bo.report_success()
+    assert bo.get_current_backoff() == 0.0
+    assert bo.can_try_now()
+    bo.report_error()
+    assert bo.get_current_backoff() == 1.0
+
+
+def test_exponential_backoff_abort_at_max():
+    from openr_tpu.utils.backoff import MaxBackoffAbortError
+
+    now = [0.0]
+    bo = ExponentialBackoff(1.0, 2.0, is_abort_at_max=True, clock=lambda: now[0])
+    bo.report_error()
+    bo.report_error()
+    assert bo.at_max_backoff()
+    with pytest.raises(MaxBackoffAbortError):
+        bo.report_error()
+
+
+def test_eventbase_stop_from_own_loop():
+    """stop() called from the module's own loop must not deadlock."""
+    evb = OpenrEventBase("selfstop")
+    evb.run()
+    evb.add_fiber_task(_self_stop(evb))
+    assert evb.wait_until_stopped(5)
+
+
+async def _self_stop(evb):
+    evb.stop()
+
+
+def test_step_detector():
+    sd = StepDetector(
+        fast_window_size=4,
+        slow_window_size=16,
+        lower_threshold_pct=0.4,
+        upper_threshold_pct=0.6,
+        abs_threshold=100.0,
+    )
+    steps = []
+    # stable around 1000us
+    for v in [1000, 1010, 990, 1000, 1005, 995, 1000]:
+        if sd.add_value(v):
+            steps.append(v)
+    assert steps == []
+    assert sd.baseline is not None
+    # jitter below threshold
+    for v in [1050, 1040, 1060, 1050]:
+        sd.add_value(v)
+    assert sd.baseline == pytest.approx(1000, rel=0.02)
+    # genuine step to ~2000us
+    detected = False
+    for v in [2000, 2010, 1990, 2000, 2005, 1995, 2000, 2000]:
+        detected = sd.add_value(v) or detected
+    assert detected
+    assert sd.baseline == pytest.approx(2000, rel=0.05)

@@ -1,0 +1,102 @@
+"""Serializer round-trip + determinism tests."""
+
+from openr_tpu import serializer
+from openr_tpu.types import (
+    Adjacency,
+    AdjacencyDatabase,
+    MplsAction,
+    MplsActionCode,
+    NextHop,
+    PrefixDatabase,
+    PrefixEntry,
+    PrefixForwardingAlgorithm,
+    PrefixMetrics,
+    PrefixType,
+    Publication,
+    UnicastRoute,
+    Value,
+    adj_key,
+    normalize_prefix,
+    prefix_key,
+)
+
+
+def _adj_db():
+    return AdjacencyDatabase(
+        this_node_name="node1",
+        adjacencies=[
+            Adjacency("node2", "if_1_2", metric=10, adj_label=65001, rtt_us=1500),
+            Adjacency("node3", "if_1_3", metric=20, is_overloaded=True),
+        ],
+        node_label=101,
+        area="area1",
+    )
+
+
+def test_roundtrip_adj_db():
+    db = _adj_db()
+    data = serializer.dumps(db)
+    back = serializer.loads(data, AdjacencyDatabase)
+    assert back == db
+    assert isinstance(back.adjacencies[0], Adjacency)
+
+
+def test_roundtrip_prefix_db():
+    db = PrefixDatabase(
+        this_node_name="node1",
+        prefix_entries=[
+            PrefixEntry(
+                prefix="10.0.0.0/24",
+                type=PrefixType.LOOPBACK,
+                forwarding_algorithm=PrefixForwardingAlgorithm.KSP2_ED_ECMP,
+                metrics=PrefixMetrics(path_preference=2000),
+                tags=("a", "b"),
+                min_nexthop=2,
+            )
+        ],
+    )
+    back = serializer.loads(serializer.dumps(db), PrefixDatabase)
+    assert back == db
+    assert back.prefix_entries[0].type is PrefixType.LOOPBACK
+    assert back.prefix_entries[0].tags == ("a", "b")
+
+
+def test_roundtrip_route_with_mpls():
+    r = UnicastRoute(
+        dest="10.0.0.0/24",
+        next_hops=[
+            NextHop(
+                address="fe80::1",
+                if_name="if_1_2",
+                metric=10,
+                mpls_action=MplsAction(MplsActionCode.PUSH, push_labels=(100, 200)),
+            )
+        ],
+    )
+    back = serializer.loads(serializer.dumps(r), UnicastRoute)
+    assert back == r
+    assert back.next_hops[0].mpls_action.push_labels == (100, 200)
+
+
+def test_determinism():
+    assert serializer.dumps(_adj_db()) == serializer.dumps(_adj_db())
+
+
+def test_publication_with_values():
+    pub = Publication(
+        key_vals={
+            "adj:node1": Value(3, "node1", serializer.dumps(_adj_db()), ttl_ms=3600000)
+        },
+        expired_keys=["adj:gone"],
+        area="0",
+    )
+    back = serializer.loads(serializer.dumps(pub), Publication)
+    assert back.key_vals["adj:node1"].version == 3
+    inner = serializer.loads(back.key_vals["adj:node1"].value, AdjacencyDatabase)
+    assert inner == _adj_db()
+
+
+def test_key_helpers():
+    assert adj_key("n1") == "adj:n1"
+    assert prefix_key("n1", "10.0.0.1/24", "0") == "prefix:[n1]:[0]:[10.0.0.0/24]"
+    assert normalize_prefix("10.0.0.1/24") == "10.0.0.0/24"
