@@ -1,0 +1,11 @@
+from .link_state import HoldableValue, Link, LinkState, LinkStateChange, NodeSpfResult
+from .prefix_state import PrefixState
+
+__all__ = [
+    "HoldableValue",
+    "Link",
+    "LinkState",
+    "LinkStateChange",
+    "NodeSpfResult",
+    "PrefixState",
+]

@@ -1,0 +1,263 @@
+"""Device-resident tensor mirror of the link-state graph.
+
+The reference walks a pointer graph (LinkState::linkMap_) per Dijkstra run;
+the TPU build mirrors the topology once into padded directed-edge arrays
+(CSR-style, sorted by destination for segment ops) and batches every SPF
+question over it (openr_tpu.ops.sssp).
+
+Shape discipline: node/edge capacities are padded to power-of-two buckets so
+incremental topology changes re-use compiled kernels; a rebuild only grows
+capacity when the bucket overflows.  Padding edges carry edge_up=False and
+point at the last padding node, keeping the dst-sorted invariant.
+
+String node ids are interned to dense int32 here — nothing above this layer
+touches the device, nothing below it sees a string.
+"""
+
+from __future__ import annotations
+
+import bisect
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from .link_state import Link, LinkState, NodeSpfResult, SpfResult
+
+
+def _next_pow2(n: int, floor: int = 8) -> int:
+    c = floor
+    while c < n:
+        c *= 2
+    return c
+
+
+@dataclass
+class CsrTopology:
+    """Padded directed-edge arrays + host-side interning tables."""
+
+    node_names: list[str]  # dense id -> name (sorted)
+    node_id: dict[str, int]
+    n_nodes: int  # real node count
+    node_capacity: int
+    edge_capacity: int
+    # numpy host arrays (device transfer happens at kernel call sites)
+    edge_src: np.ndarray  # [E_cap] int32
+    edge_dst: np.ndarray  # [E_cap] int32
+    edge_metric: np.ndarray  # [E_cap] int32
+    edge_up: np.ndarray  # [E_cap] bool
+    node_overloaded: np.ndarray  # [N_cap] bool
+    # directed edge id -> (Link, from_node_name); len == real edge count
+    edge_links: list[tuple[Link, str]]
+    n_edges: int = 0
+    version: int = -1  # LinkState.version this mirror was built from
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def from_link_state(
+        cls,
+        ls: LinkState,
+        node_capacity: Optional[int] = None,
+        edge_capacity: Optional[int] = None,
+    ) -> "CsrTopology":
+        names = ls.node_names
+        node_id = {n: i for i, n in enumerate(names)}
+        n = len(names)
+        n_cap = node_capacity or _next_pow2(n + 1)
+        assert n_cap > n, "node capacity must exceed node count (padding node)"
+
+        # two directed edges per link; deterministic order: sort by (dst, src)
+        rows: list[tuple[int, int, int, bool, Link, str]] = []
+        for link in sorted(ls.all_links):
+            for u_name in (link.n1, link.n2):
+                v_name = link.other_node_name(u_name)
+                rows.append(
+                    (
+                        node_id[v_name],  # dst first: sort key
+                        node_id[u_name],
+                        link.metric_from_node(u_name),
+                        link.is_up(),
+                        link,
+                        u_name,
+                    )
+                )
+        rows.sort(key=lambda r: (r[0], r[1]))
+        e = len(rows)
+        assert all(r[2] >= 1 for r in rows), (
+            "edge metrics must be >= 1 (distance-ordered DAG propagation "
+            "and int32 distance math rely on positive metrics)"
+        )
+        e_cap = edge_capacity or _next_pow2(e)
+        assert e_cap >= e
+
+        pad_node = n_cap - 1
+        edge_src = np.full(e_cap, pad_node, dtype=np.int32)
+        edge_dst = np.full(e_cap, pad_node, dtype=np.int32)
+        edge_metric = np.ones(e_cap, dtype=np.int32)
+        edge_up = np.zeros(e_cap, dtype=bool)
+        for i, (dst, src, metric, up, _link, _from) in enumerate(rows):
+            edge_src[i] = src
+            edge_dst[i] = dst
+            edge_metric[i] = metric
+            edge_up[i] = up
+
+        node_overloaded = np.zeros(n_cap, dtype=bool)
+        for name, i in node_id.items():
+            node_overloaded[i] = ls.is_node_overloaded(name)
+
+        return cls(
+            node_names=names,
+            node_id=node_id,
+            n_nodes=n,
+            node_capacity=n_cap,
+            edge_capacity=e_cap,
+            edge_src=edge_src,
+            edge_dst=edge_dst,
+            edge_metric=edge_metric,
+            edge_up=edge_up,
+            node_overloaded=node_overloaded,
+            edge_links=[(r[4], r[5]) for r in rows],
+            n_edges=e,
+            version=ls.version,
+        )
+
+    # -- SPF execution ------------------------------------------------------
+
+    def run_batched_spf(
+        self,
+        sources: list[str],
+        use_link_metric: bool = True,
+        extra_edge_mask: Optional[np.ndarray] = None,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Run the device kernel; returns (dist [S, N_cap], dag [S, E_cap])
+        as numpy."""
+        import jax.numpy as jnp
+
+        from ..ops import sssp as ops
+
+        src_ids = jnp.asarray(
+            [self.node_id[s] for s in sources], dtype=jnp.int32
+        )
+        e_src = jnp.asarray(self.edge_src)
+        e_dst = jnp.asarray(self.edge_dst)
+        metric = (
+            jnp.asarray(self.edge_metric)
+            if use_link_metric
+            else jnp.ones(self.edge_capacity, dtype=jnp.int32)
+        )
+        e_up = jnp.asarray(self.edge_up)
+        overloaded = jnp.asarray(self.node_overloaded)
+        allowed = ops.make_relax_allowed(
+            src_ids,
+            e_src,
+            e_up,
+            overloaded,
+            None if extra_edge_mask is None else jnp.asarray(extra_edge_mask),
+        )
+        dist = ops.batched_sssp(
+            ops.make_dist0(src_ids, self.node_capacity), e_src, e_dst, metric, allowed
+        )
+        dag = ops.sp_dag_mask(dist, e_src, e_dst, metric, allowed)
+        return np.asarray(dist), np.asarray(dag)
+
+    # -- result reconstruction (parity with the host oracle) ----------------
+
+    def to_spf_results(
+        self,
+        sources: list[str],
+        dist: np.ndarray,
+        dag: np.ndarray,
+    ) -> dict[str, SpfResult]:
+        """Convert kernel output into reference-shaped SpfResults: per node
+        metric, tie-retaining path_links, and first-hop `next_hops` sets
+        (computed by host propagation along the SP-DAG in topological
+        order)."""
+        from ..ops.sssp import INF32
+
+        inf = int(INF32)
+        out: dict[str, SpfResult] = {}
+        for row, src_name in enumerate(sources):
+            d = dist[row]
+            mask = dag[row]
+            result: SpfResult = {}
+            reachable = [
+                i for i in range(self.n_nodes) if d[i] < inf
+            ]
+            for i in reachable:
+                result[self.node_names[i]] = NodeSpfResult(int(d[i]))
+            # path links from DAG edges
+            for e in np.nonzero(mask[: self.n_edges])[0]:
+                link, from_name = self.edge_links[e]
+                v = self.node_names[int(self.edge_dst[e])]
+                result[v].path_links.append((link, from_name))
+            # First hops: propagate along the DAG in increasing-distance
+            # order (metrics are >= 1 so this is a topological order).  A
+            # direct shortest edge src->v always contributes v itself as a
+            # first hop (reference: addNextHop(otherNodeName) fires while
+            # v's set is still empty at src's pop, and survives unless a
+            # strictly shorter path resets it — i.e. iff src->v is a DAG
+            # edge).
+            src_id = self.node_id[src_name]
+            order = sorted(reachable, key=lambda i: (int(d[i]), self.node_names[i]))
+            for i in order:
+                if i == src_id:
+                    continue
+                name = self.node_names[i]
+                res = result[name]
+                for link, prev in res.path_links:
+                    if prev == src_name:
+                        res.next_hops.add(name)
+                    else:
+                        res.next_hops |= result[prev].next_hops
+            out[src_name] = result
+        return out
+
+    def spf_from(
+        self, sources: list[str], use_link_metric: bool = True
+    ) -> dict[str, SpfResult]:
+        dist, dag = self.run_batched_spf(sources, use_link_metric)
+        return self.to_spf_results(sources, dist, dag)
+
+    # -- device first-hop support -------------------------------------------
+
+    def build_edge_slots(
+        self, sources: list[str]
+    ) -> tuple[np.ndarray, list[list[str]]]:
+        """Per source row: map each out-edge of the row's source to a dense
+        'first hop slot' (index into that row's sorted unique neighbor
+        list).  Feeds ops.sssp.first_hop_matrix; slot lists translate device
+        output back to neighbor node names."""
+        slot_names: list[list[str]] = []
+        edge_slot = np.full(
+            (len(sources), self.edge_capacity), -1, dtype=np.int32
+        )
+        for row, src in enumerate(sources):
+            src_id = self.node_id[src]
+            neighbors = sorted(
+                {
+                    link.other_node_name(src)
+                    for link in self._links_of.get(src, ())
+                }
+            )
+            slot_of = {n: i for i, n in enumerate(neighbors)}
+            slot_names.append(neighbors)
+            for e in range(self.n_edges):
+                if int(self.edge_src[e]) == src_id:
+                    v = self.node_names[int(self.edge_dst[e])]
+                    edge_slot[row, e] = slot_of[v]
+        return edge_slot, slot_names
+
+    @property
+    def _links_of(self) -> dict[str, list[Link]]:
+        links: dict[str, list[Link]] = {}
+        for link, from_name in self.edge_links:
+            links.setdefault(from_name, []).append(link)
+        return links
+
+    @property
+    def max_degree(self) -> int:
+        deg: dict[str, set[str]] = {}
+        for link, from_name in self.edge_links:
+            deg.setdefault(from_name, set()).add(link.other_node_name(from_name))
+        return max((len(v) for v in deg.values()), default=0)

@@ -1,0 +1,8 @@
+from .sssp import (
+    INF32,
+    batched_sssp,
+    first_hop_matrix,
+    sp_dag_mask,
+)
+
+__all__ = ["INF32", "batched_sssp", "sp_dag_mask", "first_hop_matrix"]

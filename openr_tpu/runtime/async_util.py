@@ -44,11 +44,9 @@ class AsyncDebounce:
             self._handle = loop.call_at(now + self._min, self._fire)
         else:
             self._current_backoff = min(self._current_backoff * 2, self._max)
-            deadline = min(
-                self._first_call_ts + self._current_backoff,
-                self._first_call_ts + self._max,
-            )
-            if deadline > now:
+            deadline = self._first_call_ts + self._current_backoff
+            # once capped, the deadline stops moving — don't churn the timer
+            if deadline > now and deadline != self._handle.when():
                 self._handle.cancel()
                 self._handle = loop.call_at(deadline, self._fire)
 

@@ -19,6 +19,40 @@ from typing import Any, Awaitable, Callable, Coroutine, Optional
 log = logging.getLogger(__name__)
 
 
+class Timeout:
+    """Cancellable cross-thread timer token returned by
+    OpenrEventBase.schedule_timeout."""
+
+    def __init__(self) -> None:
+        self._handle: Optional[asyncio.TimerHandle] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._cancelled = False
+        self._lock = threading.Lock()
+
+    def _arm(
+        self, loop: asyncio.AbstractEventLoop, delay_s: float, fn: Callable[[], Any]
+    ) -> None:
+        with self._lock:
+            if self._cancelled:
+                return
+            self._loop = loop
+            self._handle = loop.call_later(delay_s, fn)
+
+    def cancel(self) -> None:
+        """Cancel from any thread.  If the timer already fired, this is a
+        no-op (cross-thread cancellation is inherently racy; callbacks should
+        tolerate one late firing)."""
+        with self._lock:
+            self._cancelled = True
+            handle, loop = self._handle, self._loop
+            self._handle = None
+        if handle is not None and loop is not None:
+            try:
+                loop.call_soon_threadsafe(handle.cancel)
+            except RuntimeError:
+                pass  # loop closed
+
+
 class OpenrEventBase:
     def __init__(self, name: str = "") -> None:
         self.name = name or type(self).__name__
@@ -26,6 +60,8 @@ class OpenrEventBase:
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
         self._stopped = threading.Event()
+        self._stop_once = threading.Lock()
+        self._stop_called = False
         self._tasks: set[asyncio.Task] = set()
         self._timestamp = time.monotonic()
 
@@ -46,7 +82,6 @@ class OpenrEventBase:
         self._loop = loop
         try:
             try:
-                loop.call_soon(self._started.set)
                 self._track(
                     loop.create_task(self._heartbeat(), name=f"{self.name}-heartbeat")
                 )
@@ -76,8 +111,16 @@ class OpenrEventBase:
             await asyncio.sleep(0.1)
 
     def stop(self) -> None:
-        """Stop the loop and join the thread (callable from any thread)."""
+        """Stop the loop and join the thread (callable from any thread;
+        idempotent — later callers just wait for the first stop to finish)."""
         if self._loop is None:
+            return
+        with self._stop_once:
+            first = not self._stop_called
+            self._stop_called = True
+        if not first:
+            if threading.current_thread() is not self._thread:
+                self.wait_until_stopped()
             return
         stopping = getattr(self, "stopping", None)
 
@@ -170,13 +213,13 @@ class OpenrEventBase:
         assert self._loop is not None
         return asyncio.run_coroutine_threadsafe(coro, self._loop)
 
-    def schedule_timeout(
-        self, delay_s: float, fn: Callable[[], Any]
-    ) -> None:
+    def schedule_timeout(self, delay_s: float, fn: Callable[[], Any]) -> "Timeout":
+        """Schedule fn after delay on this module's loop; returns a
+        cancellable token (Spark-style hold timers reset constantly)."""
         assert self._loop is not None
-        self._loop.call_soon_threadsafe(
-            lambda: self._loop.call_later(delay_s, fn)
-        )
+        token = Timeout()
+        self._loop.call_soon_threadsafe(token._arm, self._loop, delay_s, fn)
+        return token
 
     # -- watchdog interface (reference: getTimestamp, OpenrEventBase.h:74) --
 

@@ -171,6 +171,8 @@ class ReplicateQueue(Generic[T]):
         with self._lock:
             if self._closed:
                 return False
+            # prune readers that were individually closed (dead consumers)
+            self._readers = [q for q in self._readers if not q.is_closed()]
             readers = list(self._readers)
             self._num_writes += 1
         for q in readers:
@@ -184,6 +186,15 @@ class ReplicateQueue(Generic[T]):
             q: RWQueue[T] = RWQueue()
             self._readers.append(q)
             return RQueue(q)
+
+    def close_reader(self, reader: RQueue[T]) -> None:
+        """Detach one consumer: its queue is closed and pruned on next push
+        (reference culls dead readers at push time,
+        openr/messaging/ReplicateQueue.h)."""
+        with self._lock:
+            impl = reader._impl
+            self._readers = [q for q in self._readers if q is not impl]
+        impl.close()
 
     def get_num_readers(self) -> int:
         with self._lock:

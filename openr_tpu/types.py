@@ -43,8 +43,13 @@ class PerfEvents:
 
     def duration_between_ms(self, start_event: str, end_event: str) -> int:
         """Reference: getDurationBetweenPerfEvents, openr/common/Util.h:147."""
-        start = next(e for e in self.events if e.event_name == start_event)
-        end = next(e for e in self.events if e.event_name == end_event)
+        start = next(
+            (e for e in self.events if e.event_name == start_event), None
+        )
+        end = next((e for e in self.events if e.event_name == end_event), None)
+        if start is None or end is None:
+            missing = start_event if start is None else end_event
+            raise ValueError(f"perf event {missing!r} not recorded")
         if end.unix_ts_ms < start.unix_ts_ms:
             raise ValueError(f"{end_event} precedes {start_event}")
         return end.unix_ts_ms - start.unix_ts_ms

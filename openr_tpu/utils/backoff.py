@@ -23,7 +23,7 @@ class ExponentialBackoff:
         is_abort_at_max: bool = False,
         clock=time.monotonic,
     ) -> None:
-        if initial_backoff_s <= 0 or max_backoff_s <= initial_backoff_s:
+        if initial_backoff_s <= 0 or max_backoff_s < initial_backoff_s:
             raise ValueError("invalid backoff bounds")
         self._initial = initial_backoff_s
         self._max = max_backoff_s

@@ -1,0 +1,135 @@
+"""Synthetic topology builders for tests and benchmarks.
+
+Mirrors the reference benchmark topology generators (grid:
+openr/decision/tests/RoutingBenchmarkUtils.h createGrid, fat-tree fabric:
+createFabric :320) as AdjacencyDatabase factories for the new framework.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from ..types import Adjacency, AdjacencyDatabase
+
+
+def _adj(me: str, other: str, metric: int = 1) -> Adjacency:
+    return Adjacency(
+        other_node_name=other,
+        if_name=f"if_{me}_{other}",
+        other_if_name=f"if_{other}_{me}",
+        metric=metric,
+        next_hop_v6=f"fe80::{abs(hash((me, other))) % (1 << 32):x}",
+    )
+
+
+def _bidir(edges: dict[str, list[Adjacency]], a: str, b: str, metric_ab=1, metric_ba=None):
+    edges.setdefault(a, []).append(_adj(a, b, metric_ab))
+    edges.setdefault(b, []).append(_adj(b, a, metric_ba if metric_ba is not None else metric_ab))
+
+
+def _to_dbs(edges: dict[str, list[Adjacency]], area: str) -> list[AdjacencyDatabase]:
+    return [
+        AdjacencyDatabase(
+            this_node_name=node,
+            adjacencies=adjs,
+            area=area,
+            node_label=i + 1,
+        )
+        for i, (node, adjs) in enumerate(sorted(edges.items()))
+    ]
+
+
+def grid_topology(
+    n_side: int,
+    area: str = "0",
+    metric_fn=None,
+) -> list[AdjacencyDatabase]:
+    """n_side x n_side grid (reference: createGrid in
+    RoutingBenchmarkUtils)."""
+    edges: dict[str, list[Adjacency]] = {}
+
+    def name(r: int, c: int) -> str:
+        return f"node-{r}-{c}"
+
+    for r in range(n_side):
+        for c in range(n_side):
+            edges.setdefault(name(r, c), [])
+            if c + 1 < n_side:
+                m = metric_fn(r, c, "h") if metric_fn else 1
+                _bidir(edges, name(r, c), name(r, c + 1), m)
+            if r + 1 < n_side:
+                m = metric_fn(r, c, "v") if metric_fn else 1
+                _bidir(edges, name(r, c), name(r + 1, c), m)
+    return _to_dbs(edges, area)
+
+
+def fat_tree_topology(
+    n_pods: int,
+    n_planes: int = 2,
+    n_fsw_per_pod: int = 2,
+    n_rsw_per_pod: int = 4,
+    area: str = "0",
+) -> list[AdjacencyDatabase]:
+    """Three-tier fabric: spine (ssw) planes — fabric (fsw) — rack (rsw)
+    (reference: createFabric, RoutingBenchmarkUtils.h:320)."""
+    edges: dict[str, list[Adjacency]] = {}
+    n_ssw_per_plane = n_fsw_per_pod
+    for plane in range(n_planes):
+        for s in range(n_ssw_per_plane):
+            edges.setdefault(f"ssw-{plane}-{s}", [])
+    for pod in range(n_pods):
+        for f in range(n_fsw_per_pod):
+            fsw = f"fsw-{pod}-{f}"
+            edges.setdefault(fsw, [])
+            plane = f % n_planes
+            for s in range(n_ssw_per_plane):
+                _bidir(edges, fsw, f"ssw-{plane}-{s}")
+            for r in range(n_rsw_per_pod):
+                _bidir(edges, fsw, f"rsw-{pod}-{r}")
+    return _to_dbs(edges, area)
+
+
+def random_topology(
+    n_nodes: int,
+    n_extra_edges: int,
+    seed: int = 0,
+    max_metric: int = 10,
+    area: str = "0",
+) -> list[AdjacencyDatabase]:
+    """Connected random graph: spanning tree + extra edges, random metrics
+    (possibly asymmetric per direction)."""
+    rng = random.Random(seed)
+    names = [f"n{i}" for i in range(n_nodes)]
+    edges: dict[str, list[Adjacency]] = {n: [] for n in names}
+    seen: set[frozenset] = set()
+    for i in range(1, n_nodes):
+        j = rng.randrange(i)
+        seen.add(frozenset((names[i], names[j])))
+        _bidir(
+            edges,
+            names[i],
+            names[j],
+            rng.randint(1, max_metric),
+            rng.randint(1, max_metric),
+        )
+    added = 0
+    while added < n_extra_edges:
+        a, b = rng.sample(names, 2)
+        key = frozenset((a, b))
+        if key in seen:
+            continue
+        seen.add(key)
+        _bidir(edges, a, b, rng.randint(1, max_metric), rng.randint(1, max_metric))
+        added += 1
+    return _to_dbs(edges, area)
+
+
+def ring_topology(n_nodes: int, area: str = "0") -> list[AdjacencyDatabase]:
+    edges: dict[str, list[Adjacency]] = {}
+    names = [f"r{i}" for i in range(n_nodes)]
+    for i in range(n_nodes):
+        edges.setdefault(names[i], [])
+        if n_nodes > 1 and (i + 1 < n_nodes or n_nodes > 2):
+            _bidir(edges, names[i], names[(i + 1) % n_nodes])
+    return _to_dbs(edges, area)

@@ -1,0 +1,290 @@
+"""LinkState graph semantics tests (modeled on the reference's
+openr/decision/tests/LinkStateTest.cpp: SPF, ECMP ties, overloads, holds,
+k-shortest paths, adjacency DB diffs)."""
+
+import pytest
+
+from openr_tpu.decision import HoldableValue, LinkState
+from openr_tpu.decision.link_state import path_a_in_path_b
+from openr_tpu.types import Adjacency, AdjacencyDatabase
+
+
+def adj(me, other, metric=1, overloaded=False, adj_label=0):
+    return Adjacency(
+        other_node_name=other,
+        if_name=f"if_{me}_{other}",
+        other_if_name=f"if_{other}_{me}",
+        metric=metric,
+        is_overloaded=overloaded,
+        adj_label=adj_label,
+    )
+
+
+def adj_db(node, adjs, overloaded=False, node_label=0, area="0"):
+    return AdjacencyDatabase(
+        this_node_name=node,
+        adjacencies=adjs,
+        is_overloaded=overloaded,
+        node_label=node_label,
+        area=area,
+    )
+
+
+def build(dbs, area="0"):
+    ls = LinkState(area)
+    for db in dbs:
+        ls.update_adjacency_database(db)
+    return ls
+
+
+def two_node():
+    return [
+        adj_db("a", [adj("a", "b", metric=5)]),
+        adj_db("b", [adj("b", "a", metric=7)]),
+    ]
+
+
+class TestHoldableValue:
+    def test_basic(self):
+        hv = HoldableValue(10)
+        assert hv.value == 10
+        assert hv.update_value(5, hold_up_ttl=2, hold_down_ttl=4)  is False
+        # bringing-up change (5 < 10) held for 2 ticks
+        assert hv.value == 10 and hv.has_hold()
+        assert hv.decrement_ttl() is False
+        assert hv.decrement_ttl() is True
+        assert hv.value == 5 and not hv.has_hold()
+
+    def test_hold_down(self):
+        hv = HoldableValue(5)
+        hv.update_value(10, hold_up_ttl=2, hold_down_ttl=3)
+        assert hv.value == 5
+        for expect in (False, False, True):
+            assert hv.decrement_ttl() is expect
+        assert hv.value == 10
+
+    def test_update_while_held_falls_back_fast(self):
+        hv = HoldableValue(10)
+        hv.update_value(5, 2, 2)
+        assert hv.has_hold()
+        # new value while held: hold cancelled, fast update
+        assert hv.update_value(7, 2, 2) is True
+        assert hv.value == 7 and not hv.has_hold()
+
+    def test_bool_hold_false_value(self):
+        """A held value of False must still count as a hold."""
+        hv = HoldableValue(False)
+        hv.update_value(True, 2, 2)  # overloading is "down" -> hold_down
+        assert hv.value is False and hv.has_hold()
+        hv.decrement_ttl()
+        assert hv.decrement_ttl()
+        assert hv.value is True
+
+    def test_no_ttl_no_hold(self):
+        hv = HoldableValue(10)
+        assert hv.update_value(20, 0, 0) is True
+        assert hv.value == 20
+
+    def test_same_value_noop(self):
+        hv = HoldableValue(10)
+        assert hv.update_value(10, 5, 5) is False
+        assert not hv.has_hold()
+
+
+class TestLinkStateGraph:
+    def test_bidirectional_only(self):
+        ls = LinkState("0")
+        c = ls.update_adjacency_database(adj_db("a", [adj("a", "b")]))
+        assert not c.topology_changed  # no reverse adjacency yet
+        assert ls.num_links() == 0
+        c = ls.update_adjacency_database(adj_db("b", [adj("b", "a")]))
+        assert c.topology_changed
+        assert ls.num_links() == 1
+        assert ls.num_nodes() == 2
+
+    def test_mismatched_ifaces_no_link(self):
+        ls = LinkState("0")
+        a = Adjacency("b", "if1", other_if_name="ifX")
+        b = Adjacency("a", "if2", other_if_name="if1")
+        ls.update_adjacency_database(adj_db("a", [a]))
+        c = ls.update_adjacency_database(adj_db("b", [b]))
+        assert not c.topology_changed
+        assert ls.num_links() == 0
+
+    def test_spf_two_node_asymmetric(self):
+        ls = build(two_node())
+        res_a = ls.get_spf_result("a")
+        assert res_a["a"].metric == 0
+        assert res_a["b"].metric == 5
+        assert res_a["b"].next_hops == {"b"}
+        res_b = ls.get_spf_result("b")
+        assert res_b["a"].metric == 7
+
+    def test_spf_unweighted(self):
+        ls = build(two_node())
+        assert ls.get_hops_from_a_to_b("a", "b") == 1
+        assert ls.get_metric_from_a_to_b("a", "b") == 5
+        assert ls.get_metric_from_a_to_b("a", "a") == 0
+
+    def test_metric_change_topology(self):
+        ls = build(two_node())
+        c = ls.update_adjacency_database(adj_db("a", [adj("a", "b", metric=9)]))
+        assert c.topology_changed
+        assert ls.get_metric_from_a_to_b("a", "b") == 9
+
+    def test_no_change_is_noop(self):
+        ls = build(two_node())
+        c = ls.update_adjacency_database(adj_db("a", [adj("a", "b", metric=5)]))
+        assert c == type(c)()
+
+    def test_link_down(self):
+        ls = build(two_node())
+        c = ls.update_adjacency_database(adj_db("a", []))
+        assert c.topology_changed
+        assert ls.get_metric_from_a_to_b("a", "b") is None
+
+    def test_delete_adjacency_database(self):
+        ls = build(two_node())
+        c = ls.delete_adjacency_database("b")
+        assert c.topology_changed
+        assert ls.num_links() == 0
+        assert not ls.delete_adjacency_database("nope").topology_changed
+
+    def test_node_label_change(self):
+        ls = build(two_node())
+        c = ls.update_adjacency_database(
+            adj_db("a", [adj("a", "b", metric=5)], node_label=42)
+        )
+        assert c.node_label_changed and not c.topology_changed
+
+    def test_adj_label_change_is_attribute_change(self):
+        ls = build(two_node())
+        c = ls.update_adjacency_database(
+            adj_db("a", [adj("a", "b", metric=5, adj_label=999)])
+        )
+        assert c.link_attributes_changed and not c.topology_changed
+
+    def test_ecmp_square(self):
+        #   a --- b
+        #   |     |      all metric 1; a->d has two equal-cost paths
+        #   c --- d
+        ls = build(
+            [
+                adj_db("a", [adj("a", "b"), adj("a", "c")]),
+                adj_db("b", [adj("b", "a"), adj("b", "d")]),
+                adj_db("c", [adj("c", "a"), adj("c", "d")]),
+                adj_db("d", [adj("d", "b"), adj("d", "c")]),
+            ]
+        )
+        res = ls.get_spf_result("a")
+        assert res["d"].metric == 2
+        assert res["d"].next_hops == {"b", "c"}
+        assert len(res["d"].path_links) == 2
+
+    def test_node_overload_no_transit(self):
+        # a - b - c chain; overload b => c unreachable from a
+        dbs = [
+            adj_db("a", [adj("a", "b")]),
+            adj_db("b", [adj("b", "a"), adj("b", "c")]),
+            adj_db("c", [adj("c", "b")]),
+        ]
+        ls = build(dbs)
+        assert ls.get_metric_from_a_to_b("a", "c") == 2
+        ls.update_adjacency_database(
+            adj_db("b", [adj("b", "a"), adj("b", "c")], overloaded=True)
+        )
+        assert ls.is_node_overloaded("b")
+        # b itself still reachable, c is not
+        assert ls.get_metric_from_a_to_b("a", "b") == 1
+        assert ls.get_metric_from_a_to_b("a", "c") is None
+        # overloaded source can still originate traffic
+        assert ls.get_metric_from_a_to_b("b", "c") == 1
+
+    def test_link_overload_takes_link_down(self):
+        ls = build(two_node())
+        c = ls.update_adjacency_database(
+            adj_db("a", [adj("a", "b", metric=5, overloaded=True)])
+        )
+        assert c.topology_changed
+        assert ls.get_metric_from_a_to_b("a", "b") is None
+
+    def test_holds_on_new_link(self):
+        ls = LinkState("0")
+        ls.update_adjacency_database(adj_db("a", [adj("a", "b")]))
+        c = ls.update_adjacency_database(
+            adj_db("b", [adj("b", "a")]), hold_up_ttl=2, hold_down_ttl=4
+        )
+        # link exists but held down (not yet up) -> no topology change yet
+        assert not c.topology_changed
+        assert ls.has_holds()
+        assert ls.get_metric_from_a_to_b("a", "b") is None
+        assert not ls.decrement_holds().topology_changed
+        assert ls.decrement_holds().topology_changed  # ttl 2 expired
+        assert ls.get_metric_from_a_to_b("a", "b") == 1
+
+    def test_metric_hold(self):
+        ls = build(two_node())
+        # bringing-up change (lower metric) held for hold_up ticks
+        c = ls.update_adjacency_database(
+            adj_db("a", [adj("a", "b", metric=1)]), hold_up_ttl=2, hold_down_ttl=4
+        )
+        assert not c.topology_changed  # change is held
+        assert ls.get_metric_from_a_to_b("a", "b") == 5
+        ls.decrement_holds()
+        assert ls.decrement_holds().topology_changed
+        assert ls.get_metric_from_a_to_b("a", "b") == 1
+
+    def test_memoization_and_invalidation(self):
+        ls = build(two_node())
+        r1 = ls.get_spf_result("a")
+        assert ls.get_spf_result("a") is r1
+        v = ls.version
+        ls.update_adjacency_database(adj_db("a", [adj("a", "b", metric=6)]))
+        assert ls.version != v
+        assert ls.get_spf_result("a") is not r1
+
+
+class TestKthPaths:
+    def diamond(self):
+        #     b
+        #   /   \        a-b-d cost 2, a-c-d cost 2 (disjoint)
+        #  a     d       plus direct a-d cost 5
+        #   \   /
+        #     c
+        return build(
+            [
+                adj_db("a", [adj("a", "b"), adj("a", "c"), adj("a", "d", metric=5)]),
+                adj_db("b", [adj("b", "a"), adj("b", "d")]),
+                adj_db("c", [adj("c", "a"), adj("c", "d")]),
+                adj_db("d", [adj("d", "b"), adj("d", "c"), adj("d", "a", metric=5)]),
+            ]
+        )
+
+    def test_k1_gets_all_disjoint_shortest(self):
+        ls = self.diamond()
+        paths = ls.get_kth_paths("a", "d", 1)
+        assert len(paths) == 2
+        assert all(len(p) == 2 for p in paths)
+
+    def test_k2_uses_remaining_links(self):
+        ls = self.diamond()
+        paths2 = ls.get_kth_paths("a", "d", 2)
+        assert len(paths2) == 1
+        assert len(paths2[0]) == 1  # the direct a-d link
+        assert paths2[0][0].metric_from_node("a") == 5
+
+    def test_k3_empty(self):
+        ls = self.diamond()
+        assert ls.get_kth_paths("a", "d", 3) == []
+
+    def test_src_equals_dest(self):
+        ls = self.diamond()
+        assert ls.get_kth_paths("a", "a", 1) == []
+
+    def test_path_a_in_path_b(self):
+        ls = self.diamond()
+        p1, p2 = ls.get_kth_paths("a", "d", 1)
+        assert path_a_in_path_b(p1, p1)
+        assert not path_a_in_path_b(p1, p2)
+        assert path_a_in_path_b([p1[0]], p1)
+        assert not path_a_in_path_b(p1, [p1[0]])

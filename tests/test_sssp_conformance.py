@@ -1,0 +1,174 @@
+"""Conformance: the batched TPU SSSP kernel must reproduce the host
+Dijkstra oracle (which itself mirrors the reference runSpf,
+openr/decision/LinkState.cpp:809-878) — distances, tie-retaining path links,
+and first-hop (ECMP next-hop) sets — on every topology class."""
+
+import numpy as np
+import pytest
+
+from openr_tpu.decision import LinkState
+from openr_tpu.decision.csr import CsrTopology
+from openr_tpu.utils.topo import (
+    fat_tree_topology,
+    grid_topology,
+    random_topology,
+    ring_topology,
+)
+
+from test_link_state import adj, adj_db, build
+
+
+def assert_spf_equal(oracle, device, src):
+    assert set(oracle) == set(device), f"reachable set mismatch from {src}"
+    for node, o in oracle.items():
+        d = device[node]
+        assert o.metric == d.metric, f"{src}->{node} metric {o.metric} != {d.metric}"
+        assert o.next_hops == d.next_hops, (
+            f"{src}->{node} next_hops {o.next_hops} != {d.next_hops}"
+        )
+        o_links = {(l, p) for l, p in o.path_links}
+        d_links = {(l, p) for l, p in d.path_links}
+        assert o_links == d_links, f"{src}->{node} path_links differ"
+
+
+def check_all_sources(ls: LinkState, use_link_metric=True):
+    csr = CsrTopology.from_link_state(ls)
+    sources = [n for n in ls.node_names]
+    device_results = csr.spf_from(sources, use_link_metric)
+    for src in sources:
+        oracle = ls.run_spf(src, use_link_metric)
+        assert_spf_equal(oracle, device_results[src], src)
+
+
+class TestKernelParity:
+    def test_two_node(self):
+        ls = build(
+            [
+                adj_db("a", [adj("a", "b", metric=5)]),
+                adj_db("b", [adj("b", "a", metric=7)]),
+            ]
+        )
+        check_all_sources(ls)
+
+    def test_ecmp_square(self):
+        ls = build(
+            [
+                adj_db("a", [adj("a", "b"), adj("a", "c")]),
+                adj_db("b", [adj("b", "a"), adj("b", "d")]),
+                adj_db("c", [adj("c", "a"), adj("c", "d")]),
+                adj_db("d", [adj("d", "b"), adj("d", "c")]),
+            ]
+        )
+        check_all_sources(ls)
+
+    def test_grid(self):
+        ls = build(grid_topology(4))
+        check_all_sources(ls)
+
+    def test_grid_weighted(self):
+        ls = build(grid_topology(4, metric_fn=lambda r, c, d: (r * 7 + c * 3) % 5 + 1))
+        check_all_sources(ls)
+
+    def test_fat_tree(self):
+        ls = build(fat_tree_topology(3))
+        check_all_sources(ls)
+
+    def test_ring(self):
+        ls = build(ring_topology(7))
+        check_all_sources(ls)
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_random_asymmetric(self, seed):
+        ls = build(random_topology(24, 30, seed=seed))
+        check_all_sources(ls)
+
+    def test_random_unweighted_metric(self):
+        ls = build(random_topology(16, 20, seed=9))
+        check_all_sources(ls, use_link_metric=False)
+
+    def test_node_overload_drain(self):
+        dbs = grid_topology(4)
+        ls = build(dbs)
+        # overload an interior node
+        victim = "node-1-1"
+        db = next(d for d in dbs if d.this_node_name == victim)
+        db.is_overloaded = True
+        ls.update_adjacency_database(db)
+        check_all_sources(ls)
+
+    def test_link_overload(self):
+        dbs = grid_topology(3)
+        ls = build(dbs)
+        db = next(d for d in dbs if d.this_node_name == "node-0-0")
+        db.adjacencies[0].is_overloaded = True
+        ls.update_adjacency_database(db)
+        check_all_sources(ls)
+
+    def test_disconnected_components(self):
+        dbs = ring_topology(4) + [
+            adj_db("x", [adj("x", "y")]),
+            adj_db("y", [adj("y", "x")]),
+        ]
+        ls = build(dbs)
+        check_all_sources(ls)
+
+    def test_isolated_source(self):
+        """Source with no links: result contains only itself."""
+        dbs = ring_topology(4)
+        ls = build(dbs)
+        ls.update_adjacency_database(adj_db("lonely", []))
+        oracle = ls.run_spf("lonely")
+        assert set(oracle) == {"lonely"}
+        csr = CsrTopology.from_link_state(ls)
+        res = csr.spf_from(["lonely"])["lonely"]
+        assert set(res) == {"lonely"}
+
+
+class TestDeviceFirstHops:
+    """first_hop_matrix on device must agree with oracle next_hops."""
+
+    @pytest.mark.parametrize("seed", [0, 5])
+    def test_random(self, seed):
+        import jax.numpy as jnp
+
+        from openr_tpu.ops import first_hop_matrix
+        from openr_tpu.ops.sssp import (
+            batched_sssp,
+            make_dist0,
+            make_relax_allowed,
+            sp_dag_mask,
+        )
+
+        ls = build(random_topology(18, 22, seed=seed))
+        csr = CsrTopology.from_link_state(ls)
+        sources = ls.node_names
+        src_ids = jnp.asarray([csr.node_id[s] for s in sources], dtype=jnp.int32)
+        e_src = jnp.asarray(csr.edge_src)
+        e_dst = jnp.asarray(csr.edge_dst)
+        metric = jnp.asarray(csr.edge_metric)
+        allowed = make_relax_allowed(
+            src_ids, e_src, jnp.asarray(csr.edge_up), jnp.asarray(csr.node_overloaded)
+        )
+        dist = batched_sssp(
+            make_dist0(src_ids, csr.node_capacity), e_src, e_dst, metric, allowed
+        )
+        dag = sp_dag_mask(dist, e_src, e_dst, metric, allowed)
+        edge_slot, slot_names = csr.build_edge_slots(sources)
+        n_slots = max(1, csr.max_degree)
+        nh = np.asarray(
+            first_hop_matrix(
+                dag, dist, e_src, e_dst, jnp.asarray(edge_slot), n_slots
+            )
+        )
+        for row, src in enumerate(sources):
+            oracle = ls.run_spf(src)
+            for node, o in oracle.items():
+                if node == src:
+                    continue
+                nid = csr.node_id[node]
+                got = {
+                    slot_names[row][j]
+                    for j in range(len(slot_names[row]))
+                    if nh[row, nid, j]
+                }
+                assert got == o.next_hops, (src, node, got, o.next_hops)
