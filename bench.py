@@ -1,0 +1,83 @@
+"""Benchmark of record: all-sources SPF on a 1k-node grid (one chip).
+
+This is BASELINE.json config #1 ("SpfSolver CPU ref: 1k-node grid LinkState,
+single IGP metric") measured end-to-end on the device kernel: batched SSSP to
+fixed point + shortest-path-DAG extraction for ALL 1024 sources in one call
+(the reference runs 1024 sequential Dijkstras — openr/decision/
+LinkState.cpp:809 — one per getSpfResult source).
+
+Baseline for `vs_baseline` is the in-repo conformance oracle (host Dijkstra,
+same semantics), timed on a source subsample and scaled — the reference
+publishes no absolute numbers (BASELINE.md).  vs_baseline > 1 means the TPU
+path is faster.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+N_SIDE = 32  # 1024 nodes
+ORACLE_SOURCES = 16
+DEVICE_REPS = 5
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from openr_tpu.decision.csr import CsrTopology
+    from openr_tpu.decision.link_state import LinkState
+    from openr_tpu.ops import sssp as ops
+    from openr_tpu.utils.topo import grid_topology
+
+    ls = LinkState()
+    for db in grid_topology(N_SIDE):
+        ls.update_adjacency_database(db)
+    csr = CsrTopology.from_link_state(ls)
+    n = csr.n_nodes
+
+    sources = jnp.arange(n, dtype=jnp.int32)
+    e_src = jnp.asarray(csr.edge_src)
+    e_dst = jnp.asarray(csr.edge_dst)
+    metric = jnp.asarray(csr.edge_metric)
+    e_up = jnp.asarray(csr.edge_up)
+    overloaded = jnp.asarray(csr.node_overloaded)
+
+    all_sources_spf = ops.spf_forward  # the shipped flagship kernel
+
+    args = (sources, e_src, e_dst, metric, e_up, overloaded)
+    jax.block_until_ready(all_sources_spf(*args))  # compile + warm
+    times = []
+    for _ in range(DEVICE_REPS):
+        t0 = time.perf_counter()
+        jax.block_until_ready(all_sources_spf(*args))
+        times.append((time.perf_counter() - t0) * 1e3)
+    device_ms = float(np.median(times))
+
+    # host-oracle baseline on a subsample, scaled to all sources
+    sample = list(np.linspace(0, n - 1, ORACLE_SOURCES, dtype=int))
+    names = [csr.node_names[i] for i in sample]
+    t0 = time.perf_counter()
+    for name in names:
+        ls.run_spf(name)
+    oracle_ms = (time.perf_counter() - t0) * 1e3 * (n / len(names))
+
+    print(
+        json.dumps(
+            {
+                "metric": "allsrc_spf_grid1024_ms",
+                "value": round(device_ms, 3),
+                "unit": "ms",
+                "vs_baseline": round(oracle_ms / device_ms, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

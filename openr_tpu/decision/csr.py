@@ -232,20 +232,20 @@ class CsrTopology:
         edge_slot = np.full(
             (len(sources), self.edge_capacity), -1, dtype=np.int32
         )
+        links_of = self._links_of
+        edges_by_src: dict[int, list[int]] = {}
+        for e in range(self.n_edges):
+            edges_by_src.setdefault(int(self.edge_src[e]), []).append(e)
         for row, src in enumerate(sources):
             src_id = self.node_id[src]
             neighbors = sorted(
-                {
-                    link.other_node_name(src)
-                    for link in self._links_of.get(src, ())
-                }
+                {link.other_node_name(src) for link in links_of.get(src, ())}
             )
             slot_of = {n: i for i, n in enumerate(neighbors)}
             slot_names.append(neighbors)
-            for e in range(self.n_edges):
-                if int(self.edge_src[e]) == src_id:
-                    v = self.node_names[int(self.edge_dst[e])]
-                    edge_slot[row, e] = slot_of[v]
+            for e in edges_by_src.get(src_id, ()):
+                v = self.node_names[int(self.edge_dst[e])]
+                edge_slot[row, e] = slot_of[v]
         return edge_slot, slot_names
 
     @property

@@ -447,6 +447,10 @@ class LinkState:
 
         prior_db = self._adjacency_databases.get(node)
         self._adjacency_databases[node] = new_adj_db
+        if prior_db is None:
+            # node-set change: SPF memos stay valid (no links yet) but the
+            # CSR device mirror must refresh its interning tables
+            self._version += 1
 
         old_links = self.ordered_links_from_node(node)
         new_links = self._get_ordered_link_set(new_adj_db)

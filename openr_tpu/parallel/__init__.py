@@ -1,0 +1,25 @@
+"""Multi-chip scale-out for the SPF compute plane.
+
+The reference replicates the whole computation on every router (SURVEY §2.2;
+openr/decision/LinkState.cpp:809 — each node runs its own Dijkstras).  The
+TPU build instead *shards* the batched SSSP over a `jax.sharding.Mesh`:
+
+- the source-batch dimension S (independent SPF problems: sources ×
+  metric variants × what-if exclusion masks) shards over the `"batch"`
+  mesh axis — embarrassingly parallel, zero collectives;
+- the node dimension N of the distance tensor shards over the `"node"`
+  mesh axis for topologies whose [S, N] state exceeds one chip's HBM —
+  the per-iteration gather over `edge_src` then rides ICI all-gathers
+  inserted by XLA.
+
+This module is transport-free: it only places arrays.  Host-to-host state
+replication (the KvStore mesh) is a separate subsystem.
+"""
+
+from .mesh import (
+    make_mesh,
+    sharded_spf_forward,
+    spf_step_sharded,
+)
+
+__all__ = ["make_mesh", "sharded_spf_forward", "spf_step_sharded"]

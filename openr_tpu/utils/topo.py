@@ -113,6 +113,8 @@ def random_topology(
             rng.randint(1, max_metric),
             rng.randint(1, max_metric),
         )
+    max_extra = n_nodes * (n_nodes - 1) // 2 - (n_nodes - 1)
+    n_extra_edges = min(n_extra_edges, max_extra)
     added = 0
     while added < n_extra_edges:
         a, b = rng.sample(names, 2)
