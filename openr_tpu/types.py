@@ -370,6 +370,37 @@ def prefix_key(node: str, prefix: str, area: str) -> str:
     return f"prefix:[{node}]:[{area}]:[{normalize_prefix(prefix)}]"
 
 
+def parse_prefix_key(key: str) -> Optional[tuple[str, str, str]]:
+    """Parse `prefix:[node]:[area]:[cidr]` -> (node, area, prefix).
+
+    Reference: PrefixKey::fromStr (openr/common/Util.cpp)."""
+    if not key.startswith("prefix:"):
+        return None
+    body = key[len("prefix:") :]
+    parts = body.split("]:[")
+    if len(parts) != 3 or not parts[0].startswith("[") or not parts[2].endswith("]"):
+        return None
+    node = parts[0][1:]
+    area = parts[1]
+    prefix = parts[2][:-1]
+    try:
+        return node, area, normalize_prefix(prefix)
+    except ValueError:
+        return None
+
+
+def node_name_from_key(key: str) -> str:
+    """Second ':'-separated token (reference: getNodeNameFromKey,
+    openr/common/Util.cpp:891)."""
+    parts = key.split(":")
+    if len(parts) < 2:
+        return ""
+    node = parts[1]
+    if node.startswith("[") and node.endswith("]"):
+        return node[1:-1]
+    return node[1:] if node.startswith("[") else node
+
+
 def adj_key(node: str) -> str:
     """Reference: Constants::kAdjDbMarker (openr/common/Constants.h:209)."""
     return f"adj:{node}"

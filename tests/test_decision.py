@@ -1,0 +1,260 @@
+"""Decision module integration tests.
+
+Publication-driven, modeled on the reference's DecisionTest
+(openr/decision/tests/DecisionTest.cpp): drive the module thread with
+synthetic Publications and assert on emitted DecisionRouteUpdate deltas.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from openr_tpu.decision.decision import Decision
+from openr_tpu.decision.rib_policy import (
+    RibPolicyConfig,
+    RibPolicyStatementConfig,
+    RibRouteActionWeight,
+)
+from openr_tpu.runtime.queue import ReplicateQueue
+from openr_tpu.serializer import dumps
+from openr_tpu.types import (
+    Adjacency,
+    AdjacencyDatabase,
+    PerfEvents,
+    PrefixDatabase,
+    PrefixEntry,
+    Publication,
+    Value,
+    adj_key,
+    prefix_key,
+)
+
+PFX1 = "::1:0/112"
+PFX2 = "::2:0/112"
+
+
+def adj(me: str, other: str, metric: int = 10) -> Adjacency:
+    return Adjacency(
+        other_node_name=other,
+        if_name=f"{me}/{other}",
+        other_if_name=f"{other}/{me}",
+        metric=metric,
+        next_hop_v6=f"fe80::{other}",
+    )
+
+
+def adj_val(node: str, adjs: list[Adjacency], version=1, label=0, **kw) -> Value:
+    db = AdjacencyDatabase(
+        this_node_name=node, adjacencies=adjs, node_label=label, **kw
+    )
+    return Value(version=version, originator_id=node, value=dumps(db))
+
+
+def prefix_val(
+    node: str, prefix: str, version=1, entry: PrefixEntry | None = None, **kw
+) -> tuple[str, Value]:
+    db = PrefixDatabase(
+        this_node_name=node,
+        prefix_entries=[entry or PrefixEntry(prefix=prefix)],
+        **kw,
+    )
+    return prefix_key(node, prefix, "0"), Value(
+        version=version, originator_id=node, value=dumps(db)
+    )
+
+
+def square_publication() -> Publication:
+    kv = {
+        adj_key("1"): adj_val("1", [adj("1", "2"), adj("1", "3")], label=101),
+        adj_key("2"): adj_val("2", [adj("2", "1"), adj("2", "4")], label=102),
+        adj_key("3"): adj_val("3", [adj("3", "1"), adj("3", "4")], label=103),
+        adj_key("4"): adj_val("4", [adj("4", "2"), adj("4", "3")], label=104),
+    }
+    k, v = prefix_val("4", PFX1)
+    kv[k] = v
+    return Publication(key_vals=kv, area="0")
+
+
+@pytest.fixture
+def harness():
+    kvq: ReplicateQueue[Publication] = ReplicateQueue()
+    staticq: ReplicateQueue = ReplicateQueue()
+    routeq: ReplicateQueue = ReplicateQueue()
+    route_reader = routeq.get_reader()
+    decision = Decision(
+        "1",
+        kvq.get_reader(),
+        staticq.get_reader(),
+        routeq,
+        debounce_min_s=0.005,
+        debounce_max_s=0.02,
+        enable_rib_policy=True,
+    )
+    decision.run()
+    yield kvq, staticq, route_reader, decision
+    kvq.close()
+    staticq.close()
+    routeq.close()
+    decision.stop()
+    decision.wait_until_stopped(5)
+
+
+def get_update(reader, timeout=3.0):
+    return reader.get(timeout=timeout)
+
+
+class TestDecision:
+    def test_initial_convergence_and_incremental(self, harness):
+        kvq, _staticq, route_reader, decision = harness
+        kvq.push(square_publication())
+        update = get_update(route_reader)
+        assert PFX1 in update.unicast_routes_to_update
+        route = update.unicast_routes_to_update[PFX1]
+        assert {nh.neighbor_node_name for nh in route.nexthops} == {"2", "3"}
+        # node-label MPLS routes programmed too
+        assert {e.label for e in update.mpls_routes_to_update} == {
+            101,
+            102,
+            103,
+            104,
+        }
+        # perf events ride with the update
+        names = [e.event_name for e in update.perf_events.events]
+        assert "DECISION_RECEIVED" in names and "ROUTE_UPDATE" in names
+
+        # incremental: new prefix only
+        k, v = prefix_val("2", PFX2)
+        kvq.push(Publication(key_vals={k: v}, area="0"))
+        update2 = get_update(route_reader)
+        assert set(update2.unicast_routes_to_update) == {PFX2}
+        assert not update2.mpls_routes_to_update
+
+    def test_prefix_withdrawal_via_expired_key(self, harness):
+        kvq, _staticq, route_reader, _decision = harness
+        kvq.push(square_publication())
+        get_update(route_reader)
+        kvq.push(
+            Publication(
+                expired_keys=[prefix_key("4", PFX1, "0")], area="0"
+            )
+        )
+        update = get_update(route_reader)
+        assert update.unicast_routes_to_delete == [PFX1]
+
+    def test_adj_expiry_full_rebuild(self, harness):
+        kvq, _staticq, route_reader, _decision = harness
+        kvq.push(square_publication())
+        get_update(route_reader)
+        # node 2 dies: route to PFX1 now only via 3
+        kvq.push(Publication(expired_keys=[adj_key("2")], area="0"))
+        update = get_update(route_reader)
+        route = update.unicast_routes_to_update[PFX1]
+        assert {nh.neighbor_node_name for nh in route.nexthops} == {"3"}
+        assert 102 in update.mpls_routes_to_delete
+
+    def test_metric_change_reroutes(self, harness):
+        kvq, _staticq, route_reader, _decision = harness
+        kvq.push(square_publication())
+        get_update(route_reader)
+        # raise metric on 1->2: only 1->3->4 remains shortest
+        kvq.push(
+            Publication(
+                key_vals={
+                    adj_key("1"): adj_val(
+                        "1",
+                        [adj("1", "2", metric=100), adj("1", "3")],
+                        version=2,
+                        label=101,
+                    )
+                },
+                area="0",
+            )
+        )
+        update = get_update(route_reader)
+        route = update.unicast_routes_to_update[PFX1]
+        assert {nh.neighbor_node_name for nh in route.nexthops} == {"3"}
+
+    def test_rib_policy_reweights(self, harness):
+        kvq, _staticq, route_reader, decision = harness
+        kvq.push(square_publication())
+        get_update(route_reader)
+        decision.set_rib_policy(
+            RibPolicyConfig(
+                statements=[
+                    RibPolicyStatementConfig(
+                        name="t",
+                        prefixes=[PFX1],
+                        set_weight=RibRouteActionWeight(
+                            default_weight=1, neighbor_to_weight={"2": 7}
+                        ),
+                    )
+                ],
+                ttl_secs=60,
+            )
+        )
+        update = get_update(route_reader)
+        route = update.unicast_routes_to_update[PFX1]
+        weights = {nh.neighbor_node_name: nh.weight for nh in route.nexthops}
+        assert weights == {"2": 7, "3": 1}
+        cfg = decision.get_rib_policy()
+        assert cfg.statements[0].prefixes == [PFX1]
+        assert 0 < cfg.ttl_secs <= 60
+        decision.clear_rib_policy()
+        update = get_update(route_reader)
+        route = update.unicast_routes_to_update[PFX1]
+        assert {nh.weight for nh in route.nexthops} == {0}
+
+    def test_cold_start_holds_updates(self):
+        kvq: ReplicateQueue[Publication] = ReplicateQueue()
+        routeq: ReplicateQueue = ReplicateQueue()
+        route_reader = routeq.get_reader()
+        decision = Decision(
+            "1",
+            kvq.get_reader(),
+            None,
+            routeq,
+            debounce_min_s=0.005,
+            debounce_max_s=0.02,
+            eor_time_s=0.3,
+        )
+        decision.run()
+        try:
+            t0 = time.monotonic()
+            kvq.push(square_publication())
+            update = route_reader.get(timeout=3.0)
+            elapsed = time.monotonic() - t0
+            assert elapsed >= 0.25, elapsed  # held until eor expiry
+            assert PFX1 in update.unicast_routes_to_update
+        finally:
+            kvq.close()
+            routeq.close()
+            decision.stop()
+            decision.wait_until_stopped(5)
+
+    def test_get_route_db_source_parameterized(self, harness):
+        kvq, _staticq, route_reader, decision = harness
+        kvq.push(square_publication())
+        get_update(route_reader)
+        db = decision.get_route_db("3")
+        assert {
+            nh.neighbor_node_name for nh in db.unicast_routes[PFX1].nexthops
+        } == {"4"}
+        adj_dbs = decision.get_adjacency_databases()
+        assert {db.this_node_name for db in adj_dbs} == {"1", "2", "3", "4"}
+
+    def test_self_redistribution_ignored(self, harness):
+        kvq, _staticq, route_reader, decision = harness
+        kvq.push(square_publication())
+        get_update(route_reader)
+        # a reflection of our own redistributed route: area_stack ends in a
+        # known area -> ignored
+        k, v = prefix_val(
+            "1",
+            PFX2,
+            entry=PrefixEntry(prefix=PFX2, area_stack=("0",)),
+        )
+        kvq.push(Publication(key_vals={k: v}, area="0"))
+        time.sleep(0.2)
+        assert PFX2 not in decision.prefix_state.prefixes

@@ -1,0 +1,522 @@
+"""SpfSolver conformance tests.
+
+Modeled on the reference's DecisionTest route-level assertions
+(openr/decision/tests/DecisionTest.cpp): ECMP sets, KSP2 label stacks,
+best-route selection, drained filtering, MPLS label routes, static overlays,
+and route-delta computation.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from openr_tpu.decision.link_state import LinkState
+from openr_tpu.decision.prefix_state import PrefixState
+from openr_tpu.decision.rib import DecisionRouteDb
+from openr_tpu.decision.spf_solver import (
+    DeviceSpfBackend,
+    SpfSolver,
+    select_best_node_area,
+    select_best_prefix_metrics,
+)
+from openr_tpu.types import (
+    Adjacency,
+    AdjacencyDatabase,
+    MplsAction,
+    MplsActionCode,
+    MplsRoute,
+    NextHop,
+    PrefixEntry,
+    PrefixForwardingAlgorithm,
+    PrefixForwardingType,
+    PrefixMetrics,
+    PrefixType,
+    UnicastRoute,
+)
+
+
+def adj(me: str, other: str, metric: int = 10) -> Adjacency:
+    return Adjacency(
+        other_node_name=other,
+        if_name=f"{me}/{other}",
+        other_if_name=f"{other}/{me}",
+        metric=metric,
+        next_hop_v6=f"fe80::{other}",
+        next_hop_v4=f"10.0.0.{other}",
+    )
+
+
+def build_link_state(
+    adj_map: dict[str, list[Adjacency]],
+    labels: dict[str, int] | None = None,
+    overloaded: set[str] = frozenset(),
+    area: str = "0",
+) -> LinkState:
+    ls = LinkState(area)
+    for node, adjs in adj_map.items():
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name=node,
+                adjacencies=adjs,
+                is_overloaded=node in overloaded,
+                node_label=(labels or {}).get(node, 0),
+                area=area,
+            )
+        )
+    return ls
+
+
+def square() -> LinkState:
+    """1 -- 2
+       |    |
+       3 -- 4   all metric 10."""
+    return build_link_state(
+        {
+            "1": [adj("1", "2"), adj("1", "3")],
+            "2": [adj("2", "1"), adj("2", "4")],
+            "3": [adj("3", "1"), adj("3", "4")],
+            "4": [adj("4", "2"), adj("4", "3")],
+        },
+        labels={"1": 101, "2": 102, "3": 103, "4": 104},
+    )
+
+
+def prefix_state_with(
+    *entries: tuple[str, str, PrefixEntry],
+) -> PrefixState:
+    ps = PrefixState()
+    for node, area, entry in entries:
+        ps.update_prefix(node, area, entry)
+    return ps
+
+
+PFX = "::1:0/112"
+
+
+class TestEcmp:
+    def test_single_advertiser_ecmp_paths(self):
+        ls = square()
+        ps = prefix_state_with(("4", "0", PrefixEntry(prefix=PFX)))
+        solver = SpfSolver("1")
+        db = solver.build_route_db({"0": ls}, ps)
+        route = db.unicast_routes[PFX]
+        # two equal-cost paths from 1 to 4: via 2 and via 3
+        assert {nh.neighbor_node_name for nh in route.nexthops} == {"2", "3"}
+        assert all(nh.metric == 20 for nh in route.nexthops)
+        assert all(nh.mpls_action is None for nh in route.nexthops)
+
+    def test_asymmetric_metric_single_path(self):
+        adj_map = {
+            "1": [adj("1", "2"), adj("1", "3", metric=50)],
+            "2": [adj("2", "1"), adj("2", "4")],
+            "3": [adj("3", "1", metric=50), adj("3", "4")],
+            "4": [adj("4", "2"), adj("4", "3")],
+        }
+        ls = build_link_state(adj_map)
+        ps = prefix_state_with(("4", "0", PrefixEntry(prefix=PFX)))
+        db = SpfSolver("1").build_route_db({"0": ls}, ps)
+        route = db.unicast_routes[PFX]
+        assert {nh.neighbor_node_name for nh in route.nexthops} == {"2"}
+
+    def test_anycast_two_advertisers(self):
+        ls = square()
+        ps = prefix_state_with(
+            ("2", "0", PrefixEntry(prefix=PFX)),
+            ("3", "0", PrefixEntry(prefix=PFX)),
+        )
+        db = SpfSolver("1").build_route_db({"0": ls}, ps)
+        route = db.unicast_routes[PFX]
+        # both advertisers one hop away: ECMP across both neighbors
+        assert {nh.neighbor_node_name for nh in route.nexthops} == {"2", "3"}
+        assert all(nh.metric == 10 for nh in route.nexthops)
+
+    def test_self_advertised_prefix_not_programmed(self):
+        ls = square()
+        ps = prefix_state_with(("1", "0", PrefixEntry(prefix=PFX)))
+        db = SpfSolver("1").build_route_db({"0": ls}, ps)
+        assert PFX not in db.unicast_routes
+
+    def test_v4_disabled_drops_v4_prefix(self):
+        ls = square()
+        ps = prefix_state_with(("4", "0", PrefixEntry(prefix="10.1.0.0/24")))
+        db = SpfSolver("1", enable_v4=False).build_route_db({"0": ls}, ps)
+        assert "10.1.0.0/24" not in db.unicast_routes
+        db = SpfSolver("1", enable_v4=True).build_route_db({"0": ls}, ps)
+        assert "10.1.0.0/24" in db.unicast_routes
+        route = db.unicast_routes["10.1.0.0/24"]
+        assert all(nh.address.startswith("10.0.0.") for nh in route.nexthops)
+
+
+class TestBestRouteSelection:
+    def test_metrics_ordering(self):
+        entries = {
+            ("a", "0"): PrefixEntry(
+                prefix=PFX, metrics=PrefixMetrics(path_preference=1000)
+            ),
+            ("b", "0"): PrefixEntry(
+                prefix=PFX, metrics=PrefixMetrics(path_preference=2000)
+            ),
+            ("c", "0"): PrefixEntry(
+                prefix=PFX, metrics=PrefixMetrics(path_preference=2000)
+            ),
+        }
+        assert select_best_prefix_metrics(entries) == {("b", "0"), ("c", "0")}
+
+    def test_source_preference_then_distance(self):
+        e = lambda sp, d: PrefixEntry(
+            prefix=PFX,
+            metrics=PrefixMetrics(source_preference=sp, distance=d),
+        )
+        entries = {
+            ("a", "0"): e(100, 5),
+            ("b", "0"): e(200, 9),
+            ("c", "0"): e(200, 2),
+        }
+        assert select_best_prefix_metrics(entries) == {("c", "0")}
+
+    def test_best_node_area_prefers_self(self):
+        nas = {("b", "0"), ("a", "0"), ("me", "1")}
+        assert select_best_node_area(nas, "me") == ("me", "1")
+        assert select_best_node_area(nas, "zz") == ("a", "0")
+
+    def test_best_route_selection_limits_ecmp(self):
+        ls = square()
+        ps = prefix_state_with(
+            (
+                "2",
+                "0",
+                PrefixEntry(
+                    prefix=PFX, metrics=PrefixMetrics(path_preference=2000)
+                ),
+            ),
+            (
+                "3",
+                "0",
+                PrefixEntry(
+                    prefix=PFX, metrics=PrefixMetrics(path_preference=1000)
+                ),
+            ),
+        )
+        db = SpfSolver("1", enable_best_route_selection=True).build_route_db(
+            {"0": ls}, ps
+        )
+        route = db.unicast_routes[PFX]
+        assert {nh.neighbor_node_name for nh in route.nexthops} == {"2"}
+        assert route.best_area == "0"
+        assert route.best_prefix_entry.metrics.path_preference == 2000
+
+    def test_drained_node_filtered(self):
+        ls = build_link_state(
+            {
+                "1": [adj("1", "2"), adj("1", "3")],
+                "2": [adj("2", "1"), adj("2", "4")],
+                "3": [adj("3", "1"), adj("3", "4")],
+                "4": [adj("4", "2"), adj("4", "3")],
+            },
+            overloaded={"2"},
+        )
+        ps = prefix_state_with(
+            ("2", "0", PrefixEntry(prefix=PFX)),
+            ("4", "0", PrefixEntry(prefix=PFX)),
+        )
+        db = SpfSolver("1").build_route_db({"0": ls}, ps)
+        route = db.unicast_routes[PFX]
+        # advertiser 2 is drained -> only 4 counts; 2 offers no transit so
+        # the only path is via 3
+        assert {nh.neighbor_node_name for nh in route.nexthops} == {"3"}
+
+    def test_all_drained_advertisers_kept(self):
+        ls = build_link_state(
+            {
+                "1": [adj("1", "2")],
+                "2": [adj("2", "1"), adj("2", "3")],
+                "3": [adj("3", "2")],
+            },
+            overloaded={"3"},
+        )
+        ps = prefix_state_with(("3", "0", PrefixEntry(prefix=PFX)))
+        db = SpfSolver("1").build_route_db({"0": ls}, ps)
+        # sole advertiser drained: route still programmed (reference
+        # maybeFilterDrainedNodes falls back to unfiltered set)
+        assert PFX in db.unicast_routes
+
+    def test_min_nexthop_requirement_drops_route(self):
+        ls = square()
+        ps = prefix_state_with(
+            ("4", "0", PrefixEntry(prefix=PFX, min_nexthop=3))
+        )
+        db = SpfSolver("1").build_route_db({"0": ls}, ps)
+        assert PFX not in db.unicast_routes  # only 2 ECMP nexthops < 3
+        ps = prefix_state_with(
+            ("4", "0", PrefixEntry(prefix=PFX, min_nexthop=2))
+        )
+        db = SpfSolver("1").build_route_db({"0": ls}, ps)
+        assert PFX in db.unicast_routes
+
+
+class TestSrMpls:
+    def test_sp_ecmp_sr_mpls_pushes_node_label(self):
+        ls = square()
+        ps = prefix_state_with(
+            (
+                "4",
+                "0",
+                PrefixEntry(
+                    prefix=PFX,
+                    forwarding_type=PrefixForwardingType.SR_MPLS,
+                ),
+            )
+        )
+        db = SpfSolver("1").build_route_db({"0": ls}, ps)
+        route = db.unicast_routes[PFX]
+        # dst 4 is not a neighbor: push its node label on both paths
+        for nh in route.nexthops:
+            assert nh.mpls_action == MplsAction(
+                MplsActionCode.PUSH, push_labels=(104,)
+            )
+
+    def test_sr_mpls_no_push_to_neighbor(self):
+        ls = square()
+        ps = prefix_state_with(
+            (
+                "2",
+                "0",
+                PrefixEntry(
+                    prefix=PFX,
+                    forwarding_type=PrefixForwardingType.SR_MPLS,
+                ),
+            )
+        )
+        db = SpfSolver("1").build_route_db({"0": ls}, ps)
+        route = db.unicast_routes[PFX]
+        assert {nh.neighbor_node_name for nh in route.nexthops} == {"2"}
+        assert all(nh.mpls_action is None for nh in route.nexthops)
+
+    def test_node_label_routes(self):
+        ls = square()
+        db = SpfSolver("1").build_route_db({"0": ls}, PrefixState())
+        # own label: POP_AND_LOOKUP
+        own = db.mpls_routes[101]
+        (nh,) = own.nexthops
+        assert nh.mpls_action.action == MplsActionCode.POP_AND_LOOKUP
+        # neighbor label: PHP (pop at penultimate hop)
+        r2 = db.mpls_routes[102]
+        (nh2,) = [nh for nh in r2.nexthops]
+        assert nh2.neighbor_node_name == "2"
+        assert nh2.mpls_action.action == MplsActionCode.PHP
+        # remote label: SWAP via both ECMP neighbors
+        r4 = db.mpls_routes[104]
+        assert {nh.neighbor_node_name for nh in r4.nexthops} == {"2", "3"}
+        for nh in r4.nexthops:
+            assert nh.mpls_action == MplsAction(
+                MplsActionCode.SWAP, swap_label=104
+            )
+
+    def test_adjacency_label_routes(self):
+        adj12 = adj("1", "2")
+        adj12.adj_label = 50001
+        ls = build_link_state(
+            {
+                "1": [adj12],
+                "2": [adj("2", "1")],
+            }
+        )
+        db = SpfSolver("1").build_route_db({"0": ls}, PrefixState())
+        route = db.mpls_routes[50001]
+        (nh,) = route.nexthops
+        assert nh.neighbor_node_name == "2"
+        assert nh.mpls_action.action == MplsActionCode.PHP
+
+    def test_invalid_node_label_skipped(self):
+        ls = build_link_state(
+            {"1": [adj("1", "2")], "2": [adj("2", "1")]},
+            labels={"1": 101, "2": 5},  # 5 < MPLS_LABEL_MIN
+        )
+        db = SpfSolver("1").build_route_db({"0": ls}, PrefixState())
+        assert 5 not in db.mpls_routes
+
+
+class TestKsp2:
+    def test_two_edge_disjoint_paths_with_label_stacks(self):
+        """Diamond: 1-2-4 and 1-3-4; KSP2 yields both paths."""
+        ls = square()
+        ps = prefix_state_with(
+            (
+                "4",
+                "0",
+                PrefixEntry(
+                    prefix=PFX,
+                    forwarding_type=PrefixForwardingType.SR_MPLS,
+                    forwarding_algorithm=PrefixForwardingAlgorithm.KSP2_ED_ECMP,
+                ),
+            )
+        )
+        db = SpfSolver("1").build_route_db({"0": ls}, ps)
+        route = db.unicast_routes[PFX]
+        assert {nh.neighbor_node_name for nh in route.nexthops} == {"2", "3"}
+        for nh in route.nexthops:
+            assert nh.metric == 20
+            # intermediate hop's label removed for PHP; only dest label left
+            assert nh.mpls_action == MplsAction(
+                MplsActionCode.PUSH, push_labels=(104,)
+            )
+
+    def test_ksp2_longer_second_path(self):
+        """1-2 and 1-3-2: second path is longer but edge-disjoint."""
+        ls = build_link_state(
+            {
+                "1": [adj("1", "2"), adj("1", "3")],
+                "2": [adj("2", "1"), adj("2", "3")],
+                "3": [adj("3", "1"), adj("3", "2")],
+            },
+            labels={"1": 101, "2": 102, "3": 103},
+        )
+        ps = prefix_state_with(
+            (
+                "2",
+                "0",
+                PrefixEntry(
+                    prefix=PFX,
+                    forwarding_type=PrefixForwardingType.SR_MPLS,
+                    forwarding_algorithm=PrefixForwardingAlgorithm.KSP2_ED_ECMP,
+                ),
+            )
+        )
+        db = SpfSolver("1").build_route_db({"0": ls}, ps)
+        route = db.unicast_routes[PFX]
+        by_neighbor = {nh.neighbor_node_name: nh for nh in route.nexthops}
+        assert set(by_neighbor) == {"2", "3"}
+        assert by_neighbor["2"].metric == 10
+        assert by_neighbor["2"].mpls_action is None  # direct, PHP'd away
+        assert by_neighbor["3"].metric == 20
+        assert by_neighbor["3"].mpls_action == MplsAction(
+            MplsActionCode.PUSH, push_labels=(102,)
+        )
+
+    def test_ksp2_requires_sr_mpls(self):
+        ls = square()
+        ps = prefix_state_with(
+            (
+                "4",
+                "0",
+                PrefixEntry(
+                    prefix=PFX,
+                    forwarding_type=PrefixForwardingType.IP,
+                    forwarding_algorithm=PrefixForwardingAlgorithm.KSP2_ED_ECMP,
+                ),
+            )
+        )
+        solver = SpfSolver("1")
+        db = solver.build_route_db({"0": ls}, ps)
+        assert PFX not in db.unicast_routes
+        assert solver.counters["decision.incompatible_forwarding_type"] == 1
+
+
+class TestStaticRoutes:
+    def test_static_unicast_overlay(self):
+        ls = square()
+        solver = SpfSolver("1")
+        solver.update_static_unicast_routes(
+            [UnicastRoute("::2:0/112", [NextHop(address="fe80::9")])], []
+        )
+        db = solver.build_route_db({"0": ls}, PrefixState())
+        assert "::2:0/112" in db.unicast_routes
+        # computed route wins over static for the same prefix
+        solver.update_static_unicast_routes(
+            [UnicastRoute(PFX, [NextHop(address="fe80::9")])], []
+        )
+        ps = prefix_state_with(("4", "0", PrefixEntry(prefix=PFX)))
+        db = solver.build_route_db({"0": ls}, ps)
+        assert {nh.neighbor_node_name for nh in db.unicast_routes[PFX].nexthops} == {
+            "2",
+            "3",
+        }
+        solver.update_static_unicast_routes([], ["::2:0/112"])
+        db = solver.build_route_db({"0": ls}, PrefixState())
+        assert "::2:0/112" not in db.unicast_routes
+
+    def test_static_mpls(self):
+        ls = square()
+        solver = SpfSolver("1")
+        solver.update_static_mpls_routes(
+            [MplsRoute(top_label=60000, next_hops=[NextHop(address="fe80::9")])],
+            [],
+        )
+        db = solver.build_route_db({"0": ls}, PrefixState())
+        assert 60000 in db.mpls_routes
+        solver.update_static_mpls_routes([], [60000])
+        db = solver.build_route_db({"0": ls}, PrefixState())
+        assert 60000 not in db.mpls_routes
+
+
+class TestRouteDelta:
+    def test_calculate_update(self):
+        ls = square()
+        ps = prefix_state_with(("4", "0", PrefixEntry(prefix=PFX)))
+        solver = SpfSolver("1")
+        db1 = solver.build_route_db({"0": ls}, ps)
+
+        # no change -> empty delta
+        db2 = solver.build_route_db({"0": ls}, ps)
+        assert db1.calculate_update(db2).empty()
+
+        # withdraw prefix -> delete
+        delta = db1.calculate_update(solver.build_route_db({"0": ls}, PrefixState()))
+        assert delta.unicast_routes_to_delete == [PFX]
+
+        # metric change -> update
+        adj_map = {
+            "1": [adj("1", "2"), adj("1", "3", metric=50)],
+            "2": [adj("2", "1"), adj("2", "4")],
+            "3": [adj("3", "1", metric=50), adj("3", "4")],
+            "4": [adj("4", "2"), adj("4", "3")],
+        }
+        ls2 = build_link_state(adj_map, labels={"1": 101, "2": 102, "3": 103, "4": 104})
+        db3 = solver.build_route_db({"0": ls2}, ps)
+        delta = db1.calculate_update(db3)
+        assert PFX in delta.unicast_routes_to_update
+        applied = DecisionRouteDb(
+            unicast_routes=dict(db1.unicast_routes),
+            mpls_routes=dict(db1.mpls_routes),
+        )
+        applied.update(delta)
+        assert applied.unicast_routes == db3.unicast_routes
+        assert applied.mpls_routes == db3.mpls_routes
+
+    def test_build_route_db_unknown_node(self):
+        ls = square()
+        assert SpfSolver("nope").build_route_db({"0": ls}, PrefixState()) is None
+
+    def test_source_parameterized(self):
+        """getDecisionRouteDb can compute any node's routes
+        (reference: OpenrCtrlHandler -> buildRouteDb(targetNode))."""
+        ls = square()
+        ps = prefix_state_with(("4", "0", PrefixEntry(prefix=PFX)))
+        solver = SpfSolver("1")
+        db_from_2 = solver.build_route_db({"0": ls}, ps, my_node_name="2")
+        route = db_from_2.unicast_routes[PFX]
+        assert {nh.neighbor_node_name for nh in route.nexthops} == {"4"}
+        assert solver.my_node_name == "1"  # restored
+
+
+class TestDeviceBackendParity:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_random_topology_same_routes(self, seed):
+        from openr_tpu.utils.topo import random_topology
+
+        dbs = random_topology(24, 30, seed=seed)
+        ls = LinkState()
+        for db in dbs:
+            ls.update_adjacency_database(db)
+        ps = PrefixState()
+        for i, node in enumerate(["n3", "n7", "n11"]):
+            ps.update_prefix(node, "0", PrefixEntry(prefix=f"::{i+1}:0/112"))
+        ps.update_prefix("n5", "0", PrefixEntry(prefix="::a:0/112"))
+        ps.update_prefix("n9", "0", PrefixEntry(prefix="::a:0/112"))
+
+        host = SpfSolver("n0").build_route_db({"0": ls}, ps)
+        dev = SpfSolver("n0", spf_backend=DeviceSpfBackend()).build_route_db(
+            {"0": ls}, ps
+        )
+        assert host.unicast_routes == dev.unicast_routes
+        assert host.mpls_routes == dev.mpls_routes
