@@ -10,7 +10,10 @@ RibPolicy application with TTL expiry, and ordered-FIB hold decrements.
 
 from __future__ import annotations
 
+import logging
 from typing import Optional
+
+log = logging.getLogger(__name__)
 
 from ..runtime.async_util import AsyncDebounce
 from ..runtime.eventbase import OpenrEventBase
@@ -151,6 +154,17 @@ class Decision(OpenrEventBase):
         self._rebuild_debounced: Optional[AsyncDebounce] = None
         self._cold_start_pending = eor_time_s is not None
         self._ordered_fib_timeout = None
+        self.counters: dict[str, int] = {}
+
+    def _bump(self, counter: str, n: int = 1) -> None:
+        self.counters[counter] = self.counters.get(counter, 0) + n
+
+    def get_counters(self) -> dict[str, int]:
+        """Module + solver counters merged (fb303-style export)."""
+        out = dict(self.spf_solver.counters)
+        for k, v in self.counters.items():
+            out[k] = out.get(k, 0) + v
+        return out
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -199,7 +213,10 @@ class Decision(OpenrEventBase):
     def process_publication(self, pub: Publication) -> None:
         """Reference: Decision::processPublication (Decision.cpp:1683-1790)."""
         area = pub.area
-        assert area, "publication without area"
+        if not area:
+            log.error("decision: dropping publication without area")
+            self._bump("decision.error")
+            return
         link_state = self.area_link_states.setdefault(area, LinkState(area))
 
         if not pub.key_vals and not pub.expired_keys:
@@ -212,22 +229,32 @@ class Decision(OpenrEventBase):
                 self._process_key_val(key, val, area, link_state)
             except Exception:  # corrupt value: skip key, keep the fiber alive
                 # (reference: per-key try/catch, Decision.cpp:1786-1789)
-                self.spf_solver._bump("decision.error")
+                log.exception("decision: failed to process key %r", key)
+                self._bump("decision.error")
 
         for key in pub.expired_keys:
-            node = node_name_from_key(key)
-            if key.startswith(ADJ_MARKER):
-                self.pending_updates.apply_link_state_change(
-                    node, link_state.delete_adjacency_database(node), None
-                )
-            elif key.startswith(PREFIX_MARKER):
-                parsed = parse_prefix_key(key)
-                if parsed is None:
-                    continue
-                pnode, _parea, prefix = parsed
-                self.pending_updates.apply_prefix_state_change(
-                    self.prefix_state.delete_prefix(pnode, area, prefix), None
-                )
+            try:
+                self._process_expired_key(key, area, link_state)
+            except Exception:
+                log.exception("decision: failed to process expired key %r", key)
+                self._bump("decision.error")
+
+    def _process_expired_key(
+        self, key: str, area: str, link_state: LinkState
+    ) -> None:
+        node = node_name_from_key(key)
+        if key.startswith(ADJ_MARKER):
+            self.pending_updates.apply_link_state_change(
+                node, link_state.delete_adjacency_database(node), None
+            )
+        elif key.startswith(PREFIX_MARKER):
+            parsed = parse_prefix_key(key)
+            if parsed is None:
+                return
+            pnode, _parea, prefix = parsed
+            self.pending_updates.apply_prefix_state_change(
+                self.prefix_state.delete_prefix(pnode, area, prefix), None
+            )
 
     def _process_key_val(
         self, key: str, val, area: str, link_state: LinkState
@@ -246,7 +273,7 @@ class Decision(OpenrEventBase):
                         link_state.get_max_hops_to_node(adj_db.this_node_name)
                         - hold_up_ttl
                     )
-            self.spf_solver._bump("decision.adj_db_update")
+            self._bump("decision.adj_db_update")
             self.pending_updates.apply_link_state_change(
                 adj_db.this_node_name,
                 link_state.update_adjacency_database(
@@ -263,7 +290,7 @@ class Decision(OpenrEventBase):
         elif key.startswith(PREFIX_MARKER):
             prefix_db = loads(val.value, PrefixDatabase)
             if len(prefix_db.prefix_entries) != 1:
-                self.spf_solver._bump("decision.error")
+                self._bump("decision.error")
                 return
             entry = prefix_db.prefix_entries[0]
             # ignore self-redistributed route reflection
@@ -273,7 +300,7 @@ class Decision(OpenrEventBase):
                 and entry.area_stack[-1] in self.area_link_states
             ):
                 return
-            self.spf_solver._bump("decision.prefix_db_update")
+            self._bump("decision.prefix_db_update")
             node = prefix_db.this_node_name
             change = (
                 self.prefix_state.delete_prefix(node, area, entry.prefix)

@@ -1,0 +1,996 @@
+"""KvStore: replicated CRDT store with flooding and full-sync.
+
+Semantics are kept byte-exact with the reference where convergence depends
+on it (SURVEY hard-parts):
+
+- `merge_key_values` reproduces KvStore::mergeKeyValues
+  (openr/kvstore/KvStore.cpp:263-418): version > originatorId > value bytes
+  > ttlVersion tie-break chain.
+- `compare_values` reproduces KvStore::compareValues (KvStore.cpp:426-458)
+  including the -2 "unknown" result when a value is missing.
+- 3-way full sync: initiator sends its hash dump; responder returns full
+  values where it is better plus `tobe_updated_keys` where the initiator is
+  better; initiator merges and sends the finalize set back
+  (requestThriftPeerSync/processThriftSuccess/finalizeFullSync,
+  KvStore.cpp:1380-1640; dumpDifference KvStore.cpp).
+- Peer FSM: IDLE -PEER_ADD-> SYNCING -SYNC_RESP_RCVD-> INITIALIZED, any
+  error -> IDLE with exponential backoff (getNextState, KvStore.cpp:1001).
+- Flooding: merged deltas flood to INITIALIZED peers except the sender;
+  loop prevention via the nodeIds trail; token-bucket rate limiting with
+  publication buffering (floodPublication/bufferPublication,
+  KvStore.cpp:1700+).
+- TTL: countdown queue evicts keys whose originator stopped refreshing;
+  expired keys are published locally only (cleanupTtlCountdownQueue).
+
+The transport is pluggable: `InProcessTransport` wires N stores in one
+process for clusterless multi-node tests (the KvStoreWrapper pattern,
+openr/kvstore/KvStoreWrapper.h:31); the ctrl server provides the TCP
+transport between real daemons.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import hashlib
+import heapq
+import time
+from dataclasses import dataclass, field
+from typing import Any, Iterable, Optional, Protocol
+
+from ..runtime.eventbase import OpenrEventBase
+from ..runtime.queue import QueueClosedError, ReplicateQueue, RQueue
+from ..types import (
+    KvStorePeerState,
+    KvStoreSyncEvent,
+    PeerEvent,
+    PeerSpec,
+    Publication,
+    TTL_INFINITY,
+    Value,
+)
+from ..utils.backoff import ExponentialBackoff
+
+# reference: Constants.h
+INITIAL_BACKOFF_S = 0.064
+MAX_BACKOFF_S = 8.0
+PARALLEL_SYNC_LIMIT_INITIAL = 2
+PARALLEL_SYNC_LIMIT_MAX = 32
+TTL_THRESHOLD_S = 0.5  # Constants::kTtlThreshold (about-to-expire filter)
+FLOOD_PENDING_PUBLICATION_S = 0.1  # Constants::kFloodPendingPublication
+
+
+def generate_hash(version: int, originator_id: str, value: Optional[bytes]) -> int:
+    """Deterministic 63-bit hash of (version, originatorId, value)
+    (reference: generateHash, openr/common/Util.cpp)."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(str(version).encode())
+    h.update(b"\x00")
+    h.update(originator_id.encode())
+    h.update(b"\x00")
+    if value is not None:
+        h.update(value)
+    return int.from_bytes(h.digest(), "big") >> 1
+
+
+def compare_values(v1: Value, v2: Value) -> int:
+    """1 if v1 better, -1 if v2 better, 0 same, -2 unknown
+    (reference: KvStore::compareValues, KvStore.cpp:426-458)."""
+    if v1.version != v2.version:
+        return 1 if v1.version > v2.version else -1
+    if v1.originator_id != v2.originator_id:
+        return 1 if v1.originator_id > v2.originator_id else -1
+    if v1.hash is not None and v2.hash is not None and v1.hash == v2.hash:
+        if v1.ttl_version != v2.ttl_version:
+            return 1 if v1.ttl_version > v2.ttl_version else -1
+        return 0
+    if v1.value is not None and v2.value is not None:
+        if v1.value == v2.value:
+            # same logical value: retain higher ttlVersion (the reference
+            # reaches this via matching hashes; values compare equal here)
+            if v1.ttl_version != v2.ttl_version:
+                return 1 if v1.ttl_version > v2.ttl_version else -1
+            return 0
+        return 1 if v1.value > v2.value else -1
+    return -2
+
+
+class KvStoreFilters:
+    """Key-prefix + originator filter (reference: KvStoreFilters,
+    openr/kvstore/KvStore.h:71)."""
+
+    def __init__(
+        self,
+        key_prefixes: Iterable[str] = (),
+        originator_ids: Iterable[str] = (),
+    ) -> None:
+        self.key_prefixes = list(key_prefixes)
+        self.originator_ids = set(originator_ids)
+
+    def key_match(self, key: str, value: Value) -> bool:
+        """OR semantics: match either list; empty filter matches all."""
+        if not self.key_prefixes and not self.originator_ids:
+            return True
+        if self.key_prefixes and any(key.startswith(p) for p in self.key_prefixes):
+            return True
+        return bool(self.originator_ids) and value.originator_id in self.originator_ids
+
+    def key_match_all(self, key: str, value: Value) -> bool:
+        """AND semantics."""
+        if self.key_prefixes and not any(
+            key.startswith(p) for p in self.key_prefixes
+        ):
+            return False
+        if self.originator_ids and value.originator_id not in self.originator_ids:
+            return False
+        return True
+
+
+def merge_key_values(
+    kv_store: dict[str, Value],
+    key_vals: dict[str, Value],
+    filters: Optional[KvStoreFilters] = None,
+) -> dict[str, Value]:
+    """Exact CRDT merge (reference: KvStore::mergeKeyValues,
+    KvStore.cpp:263-418).  Mutates kv_store; returns the accepted delta."""
+    kv_updates: dict[str, Value] = {}
+    for key, value in key_vals.items():
+        if filters is not None and not filters.key_match(key, value):
+            continue
+        if value.ttl_ms != TTL_INFINITY and value.ttl_ms <= 0:
+            continue
+
+        existing = kv_store.get(key)
+        my_version = existing.version if existing is not None else 0
+        new_version = value.version
+        if new_version < my_version:
+            continue
+
+        update_all = False
+        update_ttl = False
+        if value.value is not None:
+            if new_version > my_version:
+                update_all = True
+            elif value.originator_id > existing.originator_id:
+                update_all = True
+            elif value.originator_id == existing.originator_id:
+                # deterministic winner when same (version, originator):
+                # higher value bytes; equal value retains higher ttlVersion
+                if existing.value is None or value.value > existing.value:
+                    update_all = True
+                elif value.value == existing.value:
+                    if value.ttl_version > existing.ttl_version:
+                        update_ttl = True
+        if (
+            value.value is None
+            and existing is not None
+            and value.version == existing.version
+            and value.originator_id == existing.originator_id
+            and value.ttl_version > existing.ttl_version
+        ):
+            update_ttl = True
+
+        if not update_all and not update_ttl:
+            continue
+
+        if update_all:
+            new_value = Value(
+                version=value.version,
+                originator_id=value.originator_id,
+                value=value.value,
+                ttl_ms=value.ttl_ms,
+                ttl_version=value.ttl_version,
+                hash=value.hash
+                if value.hash is not None
+                else generate_hash(value.version, value.originator_id, value.value),
+            )
+            kv_store[key] = new_value
+        else:  # update_ttl
+            existing.ttl_ms = value.ttl_ms
+            existing.ttl_version = value.ttl_version
+
+        kv_updates[key] = value
+    return kv_updates
+
+
+# ---------------------------------------------------------------------------
+# Transport seam
+# ---------------------------------------------------------------------------
+
+
+@dataclass(slots=True)
+class KeyDumpParams:
+    """Reference: thrift::KeyDumpParams (openr/if/Types.thrift)."""
+
+    keys: list[str] = field(default_factory=list)  # key prefixes
+    originator_ids: list[str] = field(default_factory=list)
+    key_val_hashes: Optional[dict[str, Value]] = None  # 3-way sync digest
+
+
+@dataclass(slots=True)
+class KeySetParams:
+    """Reference: thrift::KeySetParams."""
+
+    key_vals: dict[str, Value] = field(default_factory=dict)
+    node_ids: Optional[list[str]] = None
+    flood_root_id: Optional[str] = None
+    timestamp_ms: int = 0
+
+
+class KvStoreTransport(Protocol):
+    """How one store's area DB talks to a peer store (thrift in the
+    reference, SURVEY §2.3)."""
+
+    async def full_dump(
+        self, peer: PeerSpec, area: str, params: KeyDumpParams
+    ) -> Publication: ...
+
+    async def key_set(
+        self, peer: PeerSpec, area: str, params: KeySetParams
+    ) -> None: ...
+
+
+class TransportError(RuntimeError):
+    pass
+
+
+class InProcessTransport:
+    """N stores in one process; addressing by PeerSpec.peer_addr.
+
+    Supports fault injection (partitions) for tests — the MockIoProvider
+    pattern (openr/tests/mocks/MockIoProvider.h:41)."""
+
+    def __init__(self) -> None:
+        self._stores: dict[str, "KvStore"] = {}
+        self._partitioned: set[frozenset[str]] = set()
+
+    def register(self, addr: str, store: "KvStore") -> None:
+        self._stores[addr] = store
+
+    def set_partitioned(self, a: str, b: str, partitioned: bool) -> None:
+        key = frozenset((a, b))
+        if partitioned:
+            self._partitioned.add(key)
+        else:
+            self._partitioned.discard(key)
+
+    def _target(self, caller_addr: str, peer: PeerSpec) -> "KvStore":
+        store = self._stores.get(peer.peer_addr)
+        if store is None or not store.is_running:
+            raise TransportError(f"peer {peer.peer_addr} unreachable")
+        if frozenset((caller_addr, peer.peer_addr)) in self._partitioned:
+            raise TransportError(
+                f"partition between {caller_addr} and {peer.peer_addr}"
+            )
+        return store
+
+    def bind(self, addr: str) -> "_BoundInProcessTransport":
+        return _BoundInProcessTransport(self, addr)
+
+
+class _BoundInProcessTransport:
+    def __init__(self, fabric: InProcessTransport, addr: str) -> None:
+        self._fabric = fabric
+        self.addr = addr
+
+    async def full_dump(
+        self, peer: PeerSpec, area: str, params: KeyDumpParams
+    ) -> Publication:
+        store = self._fabric._target(self.addr, peer)
+        return await asyncio.wrap_future(
+            store.run_in_event_base_thread(
+                lambda: store._db(area).process_full_dump_request(params)
+            )
+        )
+
+    async def key_set(
+        self, peer: PeerSpec, area: str, params: KeySetParams
+    ) -> None:
+        store = self._fabric._target(self.addr, peer)
+        await asyncio.wrap_future(
+            store.run_in_event_base_thread(
+                lambda: store._db(area).process_key_set_request(params)
+            )
+        )
+
+
+# ---------------------------------------------------------------------------
+# TTL countdown
+# ---------------------------------------------------------------------------
+
+
+@dataclass(slots=True, order=True)
+class TtlCountdownEntry:
+    """Reference: TtlCountdownQueueEntry (KvStore.h:52-69)."""
+
+    expiry_time: float
+    key: str = field(compare=False)
+    version: int = field(compare=False)
+    ttl_version: int = field(compare=False)
+    originator_id: str = field(compare=False)
+
+
+class _TokenBucket:
+    """Reference: folly::BasicTokenBucket used for flood rate limiting
+    (KvStore.h:497, floodRate config)."""
+
+    def __init__(self, rate: float, burst: float) -> None:
+        self._rate = rate
+        self._burst = burst
+        self._tokens = burst
+        self._last = time.monotonic()
+
+    def consume(self, n: float = 1.0) -> bool:
+        now = time.monotonic()
+        self._tokens = min(self._burst, self._tokens + (now - self._last) * self._rate)
+        self._last = now
+        if self._tokens >= n:
+            self._tokens -= n
+            return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Per-area DB
+# ---------------------------------------------------------------------------
+
+
+class KvStorePeerEvent(enum.IntEnum):
+    PEER_ADD = 0
+    SYNC_RESP_RCVD = 2
+    THRIFT_API_ERROR = 3
+
+
+_NEXT_STATE = {
+    (KvStorePeerState.IDLE, KvStorePeerEvent.PEER_ADD): KvStorePeerState.SYNCING,
+    (KvStorePeerState.IDLE, KvStorePeerEvent.THRIFT_API_ERROR): KvStorePeerState.IDLE,
+    (
+        KvStorePeerState.SYNCING,
+        KvStorePeerEvent.SYNC_RESP_RCVD,
+    ): KvStorePeerState.INITIALIZED,
+    (KvStorePeerState.SYNCING, KvStorePeerEvent.THRIFT_API_ERROR): KvStorePeerState.IDLE,
+    (
+        KvStorePeerState.INITIALIZED,
+        KvStorePeerEvent.SYNC_RESP_RCVD,
+    ): KvStorePeerState.INITIALIZED,
+    (
+        KvStorePeerState.INITIALIZED,
+        KvStorePeerEvent.THRIFT_API_ERROR,
+    ): KvStorePeerState.IDLE,
+}
+
+
+def get_next_state(
+    curr: KvStorePeerState, event: KvStorePeerEvent
+) -> KvStorePeerState:
+    """Reference: KvStoreDb::getNextState (KvStore.cpp:1001-1047)."""
+    nxt = _NEXT_STATE.get((curr, event))
+    assert nxt is not None, f"invalid transition {curr} x {event}"
+    return nxt
+
+
+@dataclass
+class KvStorePeer:
+    """Reference: KvStoreDb::KvStorePeer (KvStore.h:429-453)."""
+
+    name: str
+    spec: PeerSpec
+    backoff: ExponentialBackoff
+    in_flight: bool = False
+
+
+class KvStoreDb:
+    """One area's store (reference: KvStoreDb, KvStore.h:191).
+
+    All methods run on the owning KvStore's event-base thread."""
+
+    def __init__(self, store: "KvStore", area: str) -> None:
+        self.store = store
+        self.area = area
+        self.kv: dict[str, Value] = {}
+        self.peers: dict[str, KvStorePeer] = {}
+        self._ttl_heap: list[TtlCountdownEntry] = []
+        self._ttl_timer = None
+        self._sync_timer = None
+        self._parallel_sync_limit = PARALLEL_SYNC_LIMIT_INITIAL
+        self._flood_limiter = (
+            _TokenBucket(store.flood_rate[0], store.flood_rate[1])
+            if store.flood_rate
+            else None
+        )
+        self._publication_buffer: dict[Optional[str], set[str]] = {}
+        self._pending_flood_timer = None
+        self.counters: dict[str, int] = {}
+
+    def _bump(self, counter: str, n: int = 1) -> None:
+        self.counters[counter] = self.counters.get(counter, 0) + n
+
+    # -- reads ---------------------------------------------------------------
+
+    def get_key_vals(self, keys: Iterable[str]) -> Publication:
+        pub = Publication(area=self.area)
+        for key in keys:
+            val = self.kv.get(key)
+            if val is not None:
+                pub.key_vals[key] = _copy_value(val)
+        self.update_publication_ttl(pub)
+        return pub
+
+    def dump_all_with_filters(
+        self,
+        filters: KvStoreFilters,
+        match_all: bool = False,
+        do_not_publish_value: bool = False,
+    ) -> Publication:
+        """Reference: dumpAllWithFilters."""
+        pub = Publication(area=self.area)
+        match = filters.key_match_all if match_all else filters.key_match
+        for key, val in self.kv.items():
+            if not match(key, val):
+                continue
+            out = _copy_value(val)
+            if do_not_publish_value:
+                out.value = None
+            pub.key_vals[key] = out
+        return pub
+
+    def dump_hash_with_filters(self, filters: KvStoreFilters) -> Publication:
+        """Reference: dumpHashWithFilters — version/originator/hash/ttl only."""
+        pub = Publication(area=self.area)
+        for key, val in self.kv.items():
+            if not filters.key_match(key, val):
+                continue
+            pub.key_vals[key] = Value(
+                version=val.version,
+                originator_id=val.originator_id,
+                value=None,
+                ttl_ms=val.ttl_ms,
+                ttl_version=val.ttl_version,
+                hash=val.hash,
+            )
+        return pub
+
+    def dump_difference(
+        self, my_key_vals: dict[str, Value], req_key_vals: dict[str, Value]
+    ) -> Publication:
+        """Reference: dumpDifference — keyVals I know better, plus
+        tobe_updated_keys the requester knows better."""
+        pub = Publication(area=self.area, tobe_updated_keys=[])
+        for key in set(my_key_vals) | set(req_key_vals):
+            mine = my_key_vals.get(key)
+            theirs = req_key_vals.get(key)
+            if mine is None:
+                pub.tobe_updated_keys.append(key)
+                continue
+            if theirs is None:
+                pub.key_vals[key] = mine
+                continue
+            rc = compare_values(mine, theirs)
+            if rc in (1, -2):
+                pub.key_vals[key] = mine
+            if rc in (-1, -2):
+                pub.tobe_updated_keys.append(key)
+        return pub
+
+    # -- transport-facing request handlers ------------------------------------
+
+    def process_full_dump_request(self, params: KeyDumpParams) -> Publication:
+        """Server side of full sync (reference: OpenrCtrlHandler
+        semifuture_getKvStoreKeyValsFilteredArea -> KvStoreDb)."""
+        filters = KvStoreFilters(params.keys, params.originator_ids)
+        pub = self.dump_all_with_filters(filters)
+        if params.key_val_hashes is not None:
+            pub = self.dump_difference(pub.key_vals, params.key_val_hashes)
+        self._bump("kvstore.cmd_key_dump")
+        self.update_publication_ttl(pub)
+        return pub
+
+    def process_key_set_request(self, params: KeySetParams) -> None:
+        """Server side of KEY_SET / flooding (reference:
+        semifuture_setKvStoreKeyVals -> mergePublication)."""
+        self._bump("kvstore.cmd_key_set")
+        pub = Publication(
+            key_vals=params.key_vals,
+            node_ids=params.node_ids,
+            flood_root_id=params.flood_root_id,
+            area=self.area,
+        )
+        self.merge_publication(pub)
+
+    # -- merge + flood --------------------------------------------------------
+
+    def merge_publication(
+        self, pub: Publication, sender_id: Optional[str] = None
+    ) -> int:
+        """Reference: mergePublication (KvStore.cpp)."""
+        self._bump("kvstore.received_publications")
+        self._bump("kvstore.received_key_vals", len(pub.key_vals))
+
+        need_finalize = (
+            sender_id is not None
+            and pub.tobe_updated_keys is not None
+            and len(pub.tobe_updated_keys) > 0
+        )
+        if not pub.key_vals and not need_finalize:
+            return 0
+        # loop prevention
+        if pub.node_ids is not None and self.store.node_id in pub.node_ids:
+            self._bump("kvstore.looped_publications")
+            return 0
+
+        delta = Publication(
+            key_vals=merge_key_values(self.kv, pub.key_vals, self.store.filters),
+            flood_root_id=pub.flood_root_id,
+            area=self.area,
+            node_ids=list(pub.node_ids) if pub.node_ids is not None else None,
+        )
+        kv_update_cnt = len(delta.key_vals)
+        self._bump("kvstore.updated_key_vals", kv_update_cnt)
+        self.update_ttl_countdown_queue(delta)
+        if delta.key_vals:
+            self.flood_publication(delta)
+        if need_finalize:
+            self.finalize_full_sync(pub.tobe_updated_keys, sender_id)
+        return kv_update_cnt
+
+    def set_key_vals(self, params: KeySetParams) -> None:
+        """Local API origination (reference: setKvStoreKeyVals)."""
+        for val in params.key_vals.values():
+            if val.hash is None:
+                val.hash = generate_hash(val.version, val.originator_id, val.value)
+        self.process_key_set_request(params)
+
+    def flood_publication(
+        self,
+        pub: Publication,
+        rate_limit: bool = True,
+        set_flood_root: bool = True,
+    ) -> None:
+        """Reference: floodPublication (KvStore.cpp)."""
+        if self._flood_limiter and rate_limit and not self._flood_limiter.consume(1):
+            self._buffer_publication(pub)
+            if self._pending_flood_timer is None:
+                self._pending_flood_timer = self.store.schedule_timeout(
+                    FLOOD_PENDING_PUBLICATION_S, self._flood_buffered
+                )
+            return
+        if self._publication_buffer:
+            self._buffer_publication(pub)
+            self._flood_buffered_now()
+            return
+
+        self.update_publication_ttl(pub, remove_about_to_expire=True)
+        if not pub.key_vals and not pub.expired_keys:
+            return
+
+        sender_id = pub.node_ids[-1] if pub.node_ids else None
+        if pub.node_ids is None:
+            pub.node_ids = []
+        pub.node_ids.append(self.store.node_id)
+
+        # internal subscribers
+        self.store.kvstore_updates_queue.push(pub)
+        self._bump("kvstore.num_updates")
+
+        if not pub.key_vals:
+            return  # expired-keys-only publications stay local
+
+        params = KeySetParams(
+            key_vals=dict(pub.key_vals),
+            node_ids=list(pub.node_ids),
+            flood_root_id=pub.flood_root_id,
+            timestamp_ms=int(time.time() * 1000),
+        )
+        for peer_name in self._flood_peers(pub.flood_root_id):
+            peer = self.peers.get(peer_name)
+            if peer is None or peer_name == sender_id:
+                continue
+            if peer.spec.state != KvStorePeerState.INITIALIZED:
+                continue
+            self._bump("kvstore.thrift.num_flood_pub")
+            self.store._spawn(self._flood_to_peer(peer, params))
+
+    async def _flood_to_peer(self, peer: KvStorePeer, params: KeySetParams) -> None:
+        try:
+            await self.store.transport.key_set(peer.spec, self.area, params)
+        except Exception:
+            self.process_sync_failure(peer.name)
+            self._bump("kvstore.thrift.num_flood_pub_failure")
+
+    def _flood_peers(self, flood_root_id: Optional[str]) -> list[str]:
+        """Flood-topology: all peers, or the SPT neighbors when DUAL flood
+        optimization is enabled (reference: getFloodPeers)."""
+        del flood_root_id  # DUAL flood trees: full-mesh flooding for now
+        return list(self.peers)
+
+    def _buffer_publication(self, pub: Publication) -> None:
+        self._bump("kvstore.rate_limit_suppress")
+        buf = self._publication_buffer.setdefault(pub.flood_root_id, set())
+        buf.update(pub.key_vals)
+        buf.update(pub.expired_keys)
+
+    def _flood_buffered(self) -> None:
+        self._pending_flood_timer = None
+        self._flood_buffered_now()
+
+    def _flood_buffered_now(self) -> None:
+        """Reference: floodBufferedUpdates."""
+        if not self._publication_buffer:
+            return
+        buffers, self._publication_buffer = self._publication_buffer, {}
+        for flood_root_id, keys in buffers.items():
+            pub = Publication(area=self.area, flood_root_id=flood_root_id)
+            for key in keys:
+                val = self.kv.get(key)
+                if val is not None:
+                    pub.key_vals[key] = _copy_value(val)
+                else:
+                    pub.expired_keys.append(key)
+            self.flood_publication(pub, rate_limit=False, set_flood_root=False)
+
+    # -- full sync ------------------------------------------------------------
+
+    def add_peers(self, peers: dict[str, PeerSpec]) -> None:
+        """Reference: addThriftPeers (KvStore.cpp:1660+)."""
+        for name, new_spec in peers.items():
+            spec = PeerSpec(
+                peer_addr=new_spec.peer_addr,
+                ctrl_port=new_spec.ctrl_port,
+                state=KvStorePeerState.IDLE,
+            )
+            existing = self.peers.get(name)
+            if existing is not None:
+                existing.spec = spec
+            else:
+                self.peers[name] = KvStorePeer(
+                    name=name,
+                    spec=spec,
+                    backoff=ExponentialBackoff(INITIAL_BACKOFF_S, MAX_BACKOFF_S),
+                )
+        self._schedule_sync(0.0)
+
+    def del_peers(self, peers: Iterable[str]) -> None:
+        for name in peers:
+            self.peers.pop(name, None)
+
+    def dump_peers(self) -> dict[str, PeerSpec]:
+        return {name: peer.spec for name, peer in self.peers.items()}
+
+    def get_peer_state(self, peer_name: str) -> Optional[KvStorePeerState]:
+        peer = self.peers.get(peer_name)
+        return peer.spec.state if peer else None
+
+    def get_peers_by_state(self, state: KvStorePeerState) -> list[str]:
+        return [n for n, p in self.peers.items() if p.spec.state == state]
+
+    def _schedule_sync(self, delay_s: float) -> None:
+        if self._sync_timer is not None:
+            self._sync_timer.cancel()
+        self._sync_timer = self.store.schedule_timeout(
+            delay_s, self.request_peer_sync
+        )
+
+    def request_peer_sync(self) -> None:
+        """Promote IDLE peers to SYNCING and fire full-dump requests
+        (reference: requestThriftPeerSync, KvStore.cpp:1380)."""
+        self._sync_timer = None
+        timeout = MAX_BACKOFF_S
+        num_syncing = len(self.get_peers_by_state(KvStorePeerState.SYNCING))
+        for name, peer in self.peers.items():
+            if peer.spec.state != KvStorePeerState.IDLE:
+                continue
+            if not peer.backoff.can_try_now():
+                timeout = min(timeout, peer.backoff.get_time_remaining_until_retry())
+                continue
+            peer.spec.state = get_next_state(
+                peer.spec.state, KvStorePeerEvent.PEER_ADD
+            )
+            num_syncing += 1
+            params = KeyDumpParams()
+            if self.store.filters is not None:
+                params.keys = list(self.store.filters.key_prefixes)
+                params.originator_ids = list(self.store.filters.originator_ids)
+            params.key_val_hashes = self.dump_hash_with_filters(
+                KvStoreFilters()
+            ).key_vals
+            self._bump("kvstore.thrift.num_full_sync")
+            self.store._spawn(self._full_sync_with_peer(peer, params))
+            if num_syncing > self._parallel_sync_limit:
+                timeout = MAX_BACKOFF_S
+                break
+        if (
+            self.get_peers_by_state(KvStorePeerState.IDLE)
+            or num_syncing > self._parallel_sync_limit
+        ):
+            self._schedule_sync(timeout)
+
+    async def _full_sync_with_peer(
+        self, peer: KvStorePeer, params: KeyDumpParams
+    ) -> None:
+        try:
+            pub = await self.store.transport.full_dump(
+                peer.spec, self.area, params
+            )
+        except Exception:
+            self._bump("kvstore.thrift.num_full_sync_failure")
+            self.process_sync_failure(peer.name)
+            return
+        self.process_sync_success(peer.name, pub)
+
+    def process_sync_success(self, peer_name: str, pub: Publication) -> None:
+        """Reference: processThriftSuccess (KvStore.cpp:1530-1610)."""
+        peer = self.peers.get(peer_name)
+        if peer is None:
+            return
+        if peer.spec.state == KvStorePeerState.IDLE:
+            return  # stale response; a new sync round will supersede it
+        self.merge_publication(pub, sender_id=peer_name)
+        self._bump("kvstore.thrift.num_full_sync_success")
+        peer.spec.state = get_next_state(
+            peer.spec.state, KvStorePeerEvent.SYNC_RESP_RCVD
+        )
+        peer.backoff.report_success()
+        self.store.kvstore_sync_events_queue.push(
+            KvStoreSyncEvent(peer_name, self.area)
+        )
+        self._parallel_sync_limit = min(
+            2 * self._parallel_sync_limit, PARALLEL_SYNC_LIMIT_MAX
+        )
+        if self.get_peers_by_state(KvStorePeerState.IDLE):
+            self._schedule_sync(0.0)
+
+    def process_sync_failure(self, peer_name: str) -> None:
+        """Reference: processThriftFailure (KvStore.cpp:1612-1650)."""
+        peer = self.peers.get(peer_name)
+        if peer is None:
+            return
+        peer.backoff.report_error()
+        peer.spec.state = get_next_state(
+            peer.spec.state, KvStorePeerEvent.THRIFT_API_ERROR
+        )
+        if self._sync_timer is None:
+            self._schedule_sync(0.0)
+
+    def finalize_full_sync(self, keys: list[str], sender_id: str) -> None:
+        """Reference: finalizeFullSync — send back values the peer needs."""
+        updates = Publication(area=self.area)
+        for key in keys:
+            val = self.kv.get(key)
+            if val is not None:
+                updates.key_vals[key] = _copy_value(val)
+        self.update_publication_ttl(updates)
+        if not updates.key_vals:
+            return
+        peer = self.peers.get(sender_id)
+        if peer is None or peer.spec.state == KvStorePeerState.IDLE:
+            return
+        self._bump("kvstore.thrift.num_finalized_sync")
+        params = KeySetParams(
+            key_vals=updates.key_vals,
+            timestamp_ms=int(time.time() * 1000),
+        )
+        self.store._spawn(self._flood_to_peer(peer, params))
+
+    # -- TTL ------------------------------------------------------------------
+
+    def update_ttl_countdown_queue(self, pub: Publication) -> None:
+        """Reference: updateTtlCountdownQueue."""
+        now = time.monotonic()
+        for key, value in pub.key_vals.items():
+            if value.ttl_ms == TTL_INFINITY:
+                continue
+            entry = TtlCountdownEntry(
+                expiry_time=now + value.ttl_ms / 1000.0,
+                key=key,
+                version=value.version,
+                ttl_version=value.ttl_version,
+                originator_id=value.originator_id,
+            )
+            if not self._ttl_heap or entry.expiry_time <= self._ttl_heap[0].expiry_time:
+                self._schedule_ttl_cleanup(value.ttl_ms / 1000.0)
+            heapq.heappush(self._ttl_heap, entry)
+
+    def _schedule_ttl_cleanup(self, delay_s: float) -> None:
+        if self._ttl_timer is not None:
+            self._ttl_timer.cancel()
+        self._ttl_timer = self.store.schedule_timeout(
+            max(0.0, delay_s), self.cleanup_ttl_countdown_queue
+        )
+
+    def cleanup_ttl_countdown_queue(self) -> None:
+        """Reference: cleanupTtlCountdownQueue."""
+        self._ttl_timer = None
+        expired: list[str] = []
+        now = time.monotonic()
+        while self._ttl_heap and self._ttl_heap[0].expiry_time <= now:
+            top = heapq.heappop(self._ttl_heap)
+            val = self.kv.get(top.key)
+            if (
+                val is not None
+                and val.version == top.version
+                and val.originator_id == top.originator_id
+                and val.ttl_version == top.ttl_version
+            ):
+                expired.append(top.key)
+                del self.kv[top.key]
+        if self._ttl_heap:
+            self._schedule_ttl_cleanup(self._ttl_heap[0].expiry_time - now)
+        if not expired:
+            return
+        self._bump("kvstore.expired_key_vals", len(expired))
+        # expired keys are published to local subscribers only
+        self.flood_publication(
+            Publication(expired_keys=expired, area=self.area)
+        )
+
+    def update_publication_ttl(
+        self, pub: Publication, remove_about_to_expire: bool = False
+    ) -> None:
+        """Set remaining TTL minus the decrement on outgoing values
+        (reference: updatePublicationTtl)."""
+        now = time.monotonic()
+        by_key: dict[tuple, TtlCountdownEntry] = {}
+        for entry in self._ttl_heap:
+            by_key[
+                (entry.key, entry.version, entry.originator_id, entry.ttl_version)
+            ] = entry
+        for key in list(pub.key_vals):
+            val = pub.key_vals[key]
+            entry = by_key.get((key, val.version, val.originator_id, val.ttl_version))
+            if entry is None:
+                continue
+            time_left_ms = (entry.expiry_time - now) * 1000.0
+            if time_left_ms <= self.store.ttl_decr_ms:
+                del pub.key_vals[key]
+                continue
+            if remove_about_to_expire and time_left_ms < TTL_THRESHOLD_S * 1000.0:
+                del pub.key_vals[key]
+                continue
+            val.ttl_ms = int(time_left_ms - self.store.ttl_decr_ms)
+
+
+def _copy_value(val: Value) -> Value:
+    return Value(
+        version=val.version,
+        originator_id=val.originator_id,
+        value=val.value,
+        ttl_ms=val.ttl_ms,
+        ttl_version=val.ttl_version,
+        hash=val.hash,
+    )
+
+
+# ---------------------------------------------------------------------------
+# KvStore event base
+# ---------------------------------------------------------------------------
+
+
+class KvStore(OpenrEventBase):
+    """Multi-area KvStore module (reference: KvStore, KvStore.h:541)."""
+
+    def __init__(
+        self,
+        node_id: str,
+        kvstore_updates_queue: ReplicateQueue[Publication],
+        kvstore_sync_events_queue: ReplicateQueue[KvStoreSyncEvent],
+        peer_updates_queue: Optional[RQueue[PeerEvent]] = None,
+        *,
+        transport: Optional[Any] = None,
+        areas: Iterable[str] = ("0",),
+        filters: Optional[KvStoreFilters] = None,
+        flood_rate: Optional[tuple[float, float]] = None,  # (msgs/s, burst)
+        ttl_decr_ms: int = 1,
+    ) -> None:
+        super().__init__(name=f"kvstore-{node_id}")
+        self.node_id = node_id
+        self.kvstore_updates_queue = kvstore_updates_queue
+        self.kvstore_sync_events_queue = kvstore_sync_events_queue
+        self._peer_updates_queue = peer_updates_queue
+        self.transport = transport
+        self.filters = filters
+        self.flood_rate = flood_rate
+        self.ttl_decr_ms = ttl_decr_ms
+        self._dbs: dict[str, KvStoreDb] = {
+            area: KvStoreDb(self, area) for area in areas
+        }
+
+    def _db(self, area: str) -> KvStoreDb:
+        db = self._dbs.get(area)
+        if db is None:
+            raise KeyError(f"unknown area {area!r}")
+        return db
+
+    @property
+    def areas(self) -> list[str]:
+        return list(self._dbs)
+
+    def _spawn(self, coro) -> None:
+        """Launch a transport coroutine from evb-thread context."""
+        self._track(self._loop.create_task(coro))
+
+    def run(self) -> None:
+        super().run()
+        self.wait_until_running()
+        if self._peer_updates_queue is not None:
+            self.run_in_event_base_thread(
+                lambda: self.add_fiber_task(
+                    self._peer_updates_fiber(), name="peerUpdates"
+                )
+            ).result()
+
+    async def _peer_updates_fiber(self) -> None:
+        while True:
+            try:
+                event = await self._peer_updates_queue.aget()
+            except QueueClosedError:
+                return
+            db = self._dbs.get(event.area)
+            if db is None:
+                continue
+            if event.peers_to_add:
+                db.add_peers(event.peers_to_add)
+            if event.peers_to_del:
+                db.del_peers(event.peers_to_del)
+
+    # -- thread-safe public API (reference: KvStore.h:541-683) ---------------
+
+    def _call(self, fn):
+        return self.run_in_event_base_thread(fn).result()
+
+    def get_key_vals(self, area: str, keys: Iterable[str]) -> Publication:
+        return self._call(lambda: self._db(area).get_key_vals(keys))
+
+    def set_key_vals(
+        self,
+        area: str,
+        key_vals: dict[str, Value],
+        node_ids: Optional[list[str]] = None,
+    ) -> None:
+        params = KeySetParams(key_vals=key_vals, node_ids=node_ids)
+        self._call(lambda: self._db(area).set_key_vals(params))
+
+    def dump_all(
+        self,
+        area: str,
+        key_prefixes: Iterable[str] = (),
+        originator_ids: Iterable[str] = (),
+        match_all: bool = False,
+        do_not_publish_value: bool = False,
+    ) -> Publication:
+        filters = KvStoreFilters(key_prefixes, originator_ids)
+        return self._call(
+            lambda: self._db(area).dump_all_with_filters(
+                filters, match_all, do_not_publish_value
+            )
+        )
+
+    def dump_hashes(
+        self, area: str, key_prefixes: Iterable[str] = ()
+    ) -> Publication:
+        filters = KvStoreFilters(key_prefixes)
+        return self._call(lambda: self._db(area).dump_hash_with_filters(filters))
+
+    def add_peers(self, area: str, peers: dict[str, PeerSpec]) -> None:
+        self._call(lambda: self._db(area).add_peers(peers))
+
+    def del_peers(self, area: str, peers: list[str]) -> None:
+        self._call(lambda: self._db(area).del_peers(peers))
+
+    def dump_peers(self, area: str) -> dict[str, PeerSpec]:
+        return self._call(lambda: self._db(area).dump_peers())
+
+    def get_peer_state(
+        self, area: str, peer_name: str
+    ) -> Optional[KvStorePeerState]:
+        return self._call(lambda: self._db(area).get_peer_state(peer_name))
+
+    def get_counters(self) -> dict[str, int]:
+        def _sum() -> dict[str, int]:
+            out: dict[str, int] = {}
+            for db in self._dbs.values():
+                for k, v in db.counters.items():
+                    out[k] = out.get(k, 0) + v
+                out[f"kvstore.num_keys.{db.area}"] = len(db.kv)
+            return out
+
+        return self._call(_sum)

@@ -183,14 +183,25 @@ class OpenrEventBase:
 
         self._loop.call_soon_threadsafe(_create)
 
+    def in_event_base_thread(self) -> bool:
+        return threading.current_thread() is self._thread
+
     def run_in_event_base_thread(
         self, fn: Callable[[], Any]
     ) -> "concurrent.futures.Future[Any]":
         """Marshal a call onto this module's thread and return a future for
         the result.  Reference pattern: runInEventBaseThread + SemiFuture
-        (openr/decision/Decision.cpp:1513) — the cross-thread RPC mechanism."""
+        (openr/decision/Decision.cpp:1513) — the cross-thread RPC mechanism.
+        Re-entrant: from the owning thread the call runs inline (blocking on
+        the future there would deadlock the loop)."""
         assert self._loop is not None, f"{self.name} not started"
         fut: concurrent.futures.Future = concurrent.futures.Future()
+        if self.in_event_base_thread():
+            try:
+                fut.set_result(fn())
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+            return fut
 
         def _call() -> None:
             if not fut.set_running_or_notify_cancel():

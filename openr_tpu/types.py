@@ -181,6 +181,7 @@ class Publication:
     node_ids: Optional[list[str]] = None
     tobe_updated_keys: Optional[list[str]] = None
     area: str = "0"
+    flood_root_id: Optional[str] = None
 
 
 class KvStorePeerState(enum.IntEnum):

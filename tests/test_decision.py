@@ -256,5 +256,14 @@ class TestDecision:
             entry=PrefixEntry(prefix=PFX2, area_stack=("0",)),
         )
         kvq.push(Publication(key_vals={k: v}, area="0"))
-        time.sleep(0.2)
-        assert PFX2 not in decision.prefix_state.prefixes
+        # synchronize on a later, non-reflected prefix reaching the route
+        # table so the reflected one above is known to have been processed
+        pfx3 = "::3:0/112"
+        k3, v3 = prefix_val("2", pfx3)
+        kvq.push(Publication(key_vals={k3: v3}, area="0"))
+        update = get_update(route_reader)
+        assert pfx3 in update.unicast_routes_to_update
+        prefixes = decision.run_in_event_base_thread(
+            lambda: set(decision.prefix_state.prefixes)
+        ).result()
+        assert PFX2 not in prefixes

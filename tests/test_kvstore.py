@@ -1,0 +1,360 @@
+"""KvStore tests: CRDT merge semantics + in-process multi-store mesh.
+
+Modeled on the reference's KvStoreTest.cpp / KvStoreThriftTest.cpp /
+KvStoreClientInternalTest.cpp (openr/kvstore/tests/): merge tie-breaks,
+full-sync FSM, 3-way sync, flooding, TTL expiry, persist-key ownership.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from openr_tpu.kvstore import (
+    InProcessTransport,
+    KvStore,
+    KvStoreClientInternal,
+    KvStoreFilters,
+    compare_values,
+    generate_hash,
+    merge_key_values,
+)
+from openr_tpu.runtime.eventbase import OpenrEventBase
+from openr_tpu.runtime.queue import ReplicateQueue
+from openr_tpu.types import KvStorePeerState, PeerSpec, Publication, Value
+
+
+def v(
+    version=1, originator="node1", value=b"x", ttl_ms=-1, ttl_version=0, hash=None
+) -> Value:
+    return Value(
+        version=version,
+        originator_id=originator,
+        value=value,
+        ttl_ms=ttl_ms,
+        ttl_version=ttl_version,
+        hash=hash,
+    )
+
+
+class TestMergeKeyValues:
+    """Reference: KvStoreTest mergeKeyValues cases."""
+
+    def test_new_key_and_newer_version(self):
+        store = {}
+        delta = merge_key_values(store, {"k": v(version=1)})
+        assert set(delta) == {"k"}
+        assert store["k"].version == 1
+        assert store["k"].hash is not None  # hash filled in
+
+        delta = merge_key_values(store, {"k": v(version=3, value=b"y")})
+        assert set(delta) == {"k"}
+        assert store["k"].value == b"y"
+
+    def test_old_version_skipped(self):
+        store = {"k": v(version=5)}
+        assert merge_key_values(store, {"k": v(version=4, value=b"zzz")}) == {}
+        assert store["k"].version == 5
+
+    def test_originator_tiebreak(self):
+        store = {"k": v(originator="node1")}
+        assert merge_key_values(store, {"k": v(originator="node0")}) == {}
+        delta = merge_key_values(store, {"k": v(originator="node2")})
+        assert set(delta) == {"k"}
+        assert store["k"].originator_id == "node2"
+
+    def test_value_tiebreak_same_version_same_originator(self):
+        store = {"k": v(value=b"b")}
+        assert merge_key_values(store, {"k": v(value=b"a")}) == {}
+        delta = merge_key_values(store, {"k": v(value=b"c")})
+        assert set(delta) == {"k"}
+        assert store["k"].value == b"c"
+
+    def test_ttl_version_only_update(self):
+        store = {"k": v(ttl_ms=-1)}
+        # same everything, higher ttlVersion, with value
+        delta = merge_key_values(store, {"k": v(ttl_ms=10000, ttl_version=2)})
+        assert set(delta) == {"k"}
+        assert store["k"].ttl_version == 2
+        assert store["k"].ttl_ms == 10000
+        # version-only advertisement (value=None) bumps ttl again
+        delta = merge_key_values(
+            store, {"k": v(value=None, ttl_ms=20000, ttl_version=3)}
+        )
+        assert set(delta) == {"k"}
+        assert store["k"].ttl_version == 3
+        assert store["k"].value == b"x"  # value untouched
+
+    def test_invalid_ttl_skipped(self):
+        store = {}
+        assert merge_key_values(store, {"k": v(ttl_ms=0)}) == {}
+        assert merge_key_values(store, {"k": v(ttl_ms=-7)}) == {}
+        assert store == {}
+
+    def test_ttl_refresh_for_unknown_key_ignored(self):
+        store = {}
+        assert merge_key_values(store, {"k": v(value=None, ttl_version=1)}) == {}
+
+    def test_filters(self):
+        store = {}
+        filters = KvStoreFilters(key_prefixes=["adj:"])
+        delta = merge_key_values(
+            store, {"adj:a": v(), "prefix:p": v()}, filters
+        )
+        assert set(delta) == {"adj:a"}
+
+
+class TestCompareValues:
+    def test_chain(self):
+        assert compare_values(v(version=2), v(version=1)) == 1
+        assert compare_values(v(version=1), v(version=2)) == -1
+        assert compare_values(v(originator="b"), v(originator="a")) == 1
+        assert compare_values(v(value=b"b"), v(value=b"a")) == 1
+        assert compare_values(v(), v()) == 0
+        assert (
+            compare_values(v(ttl_version=2), v(ttl_version=1)) == 1
+        )
+        # unknown when a value is missing and hashes don't match
+        assert compare_values(v(value=None), v(value=b"a")) == -2
+
+    def test_hash_equality_path(self):
+        h = generate_hash(1, "node1", b"x")
+        assert compare_values(v(hash=h, value=None), v(hash=h)) == 0
+
+
+def make_store(name, fabric, areas=("0",), **kw):
+    updates: ReplicateQueue[Publication] = ReplicateQueue()
+    syncs: ReplicateQueue = ReplicateQueue()
+    peerq: ReplicateQueue = ReplicateQueue()
+    store = KvStore(
+        name,
+        updates,
+        syncs,
+        peerq.get_reader(),
+        transport=fabric.bind(name),
+        areas=areas,
+        **kw,
+    )
+    fabric.register(name, store)
+    store.run()
+    return store, updates, syncs, peerq
+
+
+def spec(addr: str) -> PeerSpec:
+    return PeerSpec(peer_addr=addr)
+
+
+def wait_for(cond, timeout=5.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def fabric():
+    fab = InProcessTransport()
+    stores = []
+
+    def _make(name, **kw):
+        parts = make_store(name, fab, **kw)
+        stores.append(parts)
+        return parts
+
+    yield fab, _make
+    for store, updates, syncs, peerq in stores:
+        updates.close()
+        syncs.close()
+        peerq.close()
+        store.stop()
+    for store, *_ in stores:
+        store.wait_until_stopped(5)
+
+
+class TestKvStoreMesh:
+    def test_full_sync_two_stores(self, fabric):
+        fab, make = fabric
+        a, _, _, _ = make("a")
+        b, _, b_syncs, _ = make("b")
+        sync_reader = b_syncs.get_reader()
+
+        a.set_key_vals("0", {"k1": v(originator="a", value=b"v1")})
+        b.add_peers("0", {"a": spec("a")})
+
+        event = sync_reader.get(timeout=5)
+        assert event.node_name == "a"
+        assert b.get_peer_state("0", "a") == KvStorePeerState.INITIALIZED
+        assert b.get_key_vals("0", ["k1"]).key_vals["k1"].value == b"v1"
+
+    def test_three_way_sync_sends_back_better_keys(self, fabric):
+        fab, make = fabric
+        a, a_updates, _, _ = make("a")
+        b, _, b_syncs, _ = make("b")
+        a.set_key_vals("0", {"k1": v(originator="a", value=b"v1")})
+        b.set_key_vals("0", {"k2": v(version=7, originator="b", value=b"v2")})
+        reader = b_syncs.get_reader()
+
+        # bidirectional peering so the finalize leg can flood onward
+        a.add_peers("0", {"b": spec("b")})
+        b.add_peers("0", {"a": spec("a")})
+        reader.get(timeout=5)
+
+        # b learned k1 from the dump; a learned k2 from the finalize step
+        assert b.get_key_vals("0", ["k1"]).key_vals["k1"].value == b"v1"
+        assert wait_for(
+            lambda: a.get_key_vals("0", ["k2"]).key_vals.get("k2") is not None
+        )
+        assert a.get_key_vals("0", ["k2"]).key_vals["k2"].version == 7
+
+    def test_flooding_line_topology(self, fabric):
+        fab, make = fabric
+        a, _, _, _ = make("a")
+        b, _, _, _ = make("b")
+        c, _, _, _ = make("c")
+        # line: a - b - c with bidirectional peering
+        a.add_peers("0", {"b": spec("b")})
+        b.add_peers("0", {"a": spec("a"), "c": spec("c")})
+        c.add_peers("0", {"b": spec("b")})
+        assert wait_for(
+            lambda: c.get_peer_state("0", "b") == KvStorePeerState.INITIALIZED
+            and a.get_peer_state("0", "b") == KvStorePeerState.INITIALIZED
+            and b.get_peer_state("0", "a") == KvStorePeerState.INITIALIZED
+            and b.get_peer_state("0", "c") == KvStorePeerState.INITIALIZED
+        )
+
+        a.set_key_vals("0", {"flood-key": v(originator="a", value=b"fv")})
+        assert wait_for(
+            lambda: c.get_key_vals("0", ["flood-key"]).key_vals.get("flood-key")
+            is not None
+        )
+        # loop prevention: the publication doesn't bounce forever; nodeIds
+        # trail carried the path
+        counters = b.get_counters()
+        assert counters.get("kvstore.looped_publications", 0) >= 0
+
+    def test_publication_emitted_to_local_subscribers(self, fabric):
+        fab, make = fabric
+        a, a_updates, _, _ = make("a")
+        reader = a_updates.get_reader()
+        a.set_key_vals("0", {"k": v(originator="a")})
+        pub = reader.get(timeout=5)
+        assert "k" in pub.key_vals
+        assert pub.node_ids == ["a"]
+
+    def test_ttl_expiry(self, fabric):
+        fab, make = fabric
+        a, a_updates, _, _ = make("a")
+        reader = a_updates.get_reader()
+        # ttl must exceed the 500ms about-to-expire flood threshold or the
+        # set is (correctly) never published at all
+        a.set_key_vals("0", {"mortal": v(originator="a", ttl_ms=700)})
+        pub = reader.get(timeout=5)  # the set itself
+        assert "mortal" in pub.key_vals
+        pub = reader.get(timeout=5)  # the expiry
+        assert pub.expired_keys == ["mortal"]
+        assert a.get_key_vals("0", ["mortal"]).key_vals == {}
+
+    def test_ttl_decrement_on_sync(self, fabric):
+        fab, make = fabric
+        a, _, _, _ = make("a", ttl_decr_ms=100)
+        b, _, b_syncs, _ = make("b")
+        reader = b_syncs.get_reader()
+        a.set_key_vals("0", {"k": v(originator="a", ttl_ms=60000)})
+        b.add_peers("0", {"a": spec("a")})
+        reader.get(timeout=5)
+        got = b.get_key_vals("0", ["k"]).key_vals["k"]
+        assert got.ttl_ms < 60000  # decremented in flight
+
+    def test_partition_backoff_and_recovery(self, fabric):
+        fab, make = fabric
+        a, _, _, _ = make("a")
+        b, _, b_syncs, _ = make("b")
+        reader = b_syncs.get_reader()
+        fab.set_partitioned("a", "b", True)
+        a.set_key_vals("0", {"k": v(originator="a")})
+        b.add_peers("0", {"a": spec("a")})
+        time.sleep(0.3)
+        assert b.get_peer_state("0", "a") == KvStorePeerState.IDLE
+        fab.set_partitioned("a", "b", False)
+        reader.get(timeout=10)  # backoff retry succeeds
+        assert b.get_peer_state("0", "a") == KvStorePeerState.INITIALIZED
+        assert b.get_key_vals("0", ["k"]).key_vals.get("k") is not None
+
+    def test_areas_are_isolated(self, fabric):
+        fab, make = fabric
+        a, _, _, _ = make("a", areas=("0", "1"))
+        a.set_key_vals("1", {"k": v(originator="a")})
+        assert a.get_key_vals("0", ["k"]).key_vals == {}
+        assert a.get_key_vals("1", ["k"]).key_vals["k"].value == b"x"
+
+
+class TestKvStoreClient:
+    def test_persist_key_ownership(self, fabric):
+        fab, make = fabric
+        a, a_updates, _, _ = make("a")
+        evb = OpenrEventBase(name="client-evb")
+        evb.run()
+        try:
+            client = KvStoreClientInternal(
+                evb, "a", a, a_updates.get_reader(), check_persist_interval_s=60
+            )
+            client.persist_key("0", "my-key", b"mine")
+            assert a.get_key_vals("0", ["my-key"]).key_vals["my-key"].value == b"mine"
+
+            # another node overwrites with higher version -> we win it back
+            a.set_key_vals(
+                "0",
+                {"my-key": v(version=5, originator="z", value=b"theirs")},
+            )
+            assert wait_for(
+                lambda: (
+                    lambda kv: kv is not None
+                    and kv.value == b"mine"
+                    and kv.version > 5
+                )(a.get_key_vals("0", ["my-key"]).key_vals.get("my-key"))
+            )
+            client.stop()
+        finally:
+            evb.stop()
+            evb.wait_until_stopped(5)
+
+    def test_ttl_refresh_keeps_key_alive(self, fabric):
+        fab, make = fabric
+        a, a_updates, _, _ = make("a")
+        evb = OpenrEventBase(name="client-evb2")
+        evb.run()
+        try:
+            client = KvStoreClientInternal(
+                evb, "a", a, a_updates.get_reader(), check_persist_interval_s=60
+            )
+            client.persist_key("0", "lively", b"val", ttl_ms=300)
+            time.sleep(1.0)  # several TTL periods
+            got = a.get_key_vals("0", ["lively"]).key_vals.get("lively")
+            assert got is not None and got.ttl_version > 0
+            client.stop()
+        finally:
+            evb.stop()
+            evb.wait_until_stopped(5)
+
+    def test_set_key_version_bump_and_subscribe(self, fabric):
+        fab, make = fabric
+        a, a_updates, _, _ = make("a")
+        evb = OpenrEventBase(name="client-evb3")
+        evb.run()
+        try:
+            client = KvStoreClientInternal(
+                evb, "a", a, a_updates.get_reader(), check_persist_interval_s=60
+            )
+            seen = []
+            client.subscribe_key("0", "s-key", lambda k, val: seen.append(val))
+            client.set_key("0", "s-key", b"v1")
+            assert wait_for(lambda: len(seen) >= 1)
+            val2 = client.set_key("0", "s-key", b"v2")
+            assert val2.version == 2  # auto-bumped
+            client.stop()
+        finally:
+            evb.stop()
+            evb.wait_until_stopped(5)
