@@ -1,0 +1,9 @@
+"""LinkMonitor: interface tracking + adjacency advertisement.
+
+Functional equivalent of the reference's LinkMonitor
+(openr/link-monitor/LinkMonitor.h:95).
+"""
+
+from .link_monitor import AdjKey, InterfaceEntry, LinkMonitor, LinkMonitorState
+
+__all__ = ["AdjKey", "InterfaceEntry", "LinkMonitor", "LinkMonitorState"]

@@ -1,0 +1,20 @@
+"""Spark: neighbor discovery over link-local multicast.
+
+Functional equivalent of the reference's Spark (openr/spark/): hello /
+handshake / heartbeat protocol with a 5-state per-neighbor FSM
+(IDLE/WARM/NEGOTIATE/ESTABLISHED/RESTART), RTT measurement, area
+negotiation, and graceful-restart support, over a mockable IoProvider.
+"""
+
+from .io_provider import IoProvider, MockIoProvider, UdpIoProvider
+from .spark import Spark, SparkNeighState, SparkConfig, AreaConfig
+
+__all__ = [
+    "AreaConfig",
+    "IoProvider",
+    "MockIoProvider",
+    "Spark",
+    "SparkConfig",
+    "SparkNeighState",
+    "UdpIoProvider",
+]

@@ -246,6 +246,7 @@ class SparkHandshakeMsg:
     transport_addr_v6: str
     transport_addr_v4: str
     openr_ctrl_port: int
+    kvstore_cmd_port: int = 0
     area: str = "0"
     neighbor_node_name: Optional[str] = None
 
@@ -255,6 +256,17 @@ class SparkHeartbeatMsg:
     node_name: str
     seq_num: int
     hold_time_ms: int = 0
+
+
+@dataclass(slots=True)
+class SparkPacket:
+    """One-of wrapper for the three Spark messages (reference:
+    thrift::SparkPacket — exactly one member populated at a time)."""
+
+    hello: Optional[SparkHelloMsg] = None
+    handshake: Optional[SparkHandshakeMsg] = None
+    heartbeat: Optional[SparkHeartbeatMsg] = None
+    version: int = 1
 
 
 class NeighborEventType(enum.IntEnum):
@@ -271,6 +283,7 @@ class NeighborEvent:
     event_type: NeighborEventType
     node_name: str
     if_name: str
+    remote_if_name: str = ""
     area: str = "0"
     neighbor_addr_v6: str = ""
     neighbor_addr_v4: str = ""
@@ -297,6 +310,37 @@ class InterfaceInfo:
 class InterfaceDatabase:
     this_node_name: str
     interfaces: dict[str, InterfaceInfo] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# Netlink / platform events (reference: openr/nl/NetlinkTypes.h,
+# fbnl::Link/IfAddress — consumed by LinkMonitor via netlinkEventsQueue)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(slots=True)
+class LinkEvent:
+    if_name: str
+    if_index: int
+    is_up: bool
+
+
+@dataclass(slots=True)
+class AddrEvent:
+    if_name: str
+    prefix: str  # CIDR
+    is_valid: bool  # False == address removed
+
+
+@dataclass(slots=True)
+class PrefixUpdateRequest:
+    """Advertise/withdraw origination requests into PrefixManager
+    (reference: PrefixUpdateRequest via prefixUpdatesQueue)."""
+
+    prefixes_to_add: list[PrefixEntry] = field(default_factory=list)
+    prefixes_to_del: list[str] = field(default_factory=list)
+    type: Optional[PrefixType] = None  # origination source
+    dst_areas: tuple[str, ...] = ()
 
 
 # ---------------------------------------------------------------------------

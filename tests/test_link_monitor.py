@@ -1,0 +1,286 @@
+"""LinkMonitor tests (modeled on openr/link-monitor/tests/LinkMonitorTest.cpp):
+interface flap backoff, neighbor -> peer + adjacency advertisement gated on
+KvStore initial sync, drain state APIs, RTT metrics.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+import openr_tpu.link_monitor.link_monitor as lm_mod
+from openr_tpu.kvstore import InProcessTransport, KvStore, KvStoreClientInternal
+from openr_tpu.link_monitor import LinkMonitor
+from openr_tpu.runtime.queue import ReplicateQueue
+from openr_tpu.serializer import loads
+from openr_tpu.types import (
+    AddrEvent,
+    AdjacencyDatabase,
+    KvStoreSyncEvent,
+    LinkEvent,
+    NeighborEvent,
+    NeighborEventType,
+    adj_key,
+)
+
+
+def neighbor_up(node, if_name="if1", area="0", rtt_us=1000) -> NeighborEvent:
+    return NeighborEvent(
+        event_type=NeighborEventType.NEIGHBOR_UP,
+        node_name=node,
+        if_name=if_name,
+        remote_if_name=f"{if_name}-r",
+        area=area,
+        neighbor_addr_v6=f"fe80::{node}",
+        ctrl_port=2018,
+        rtt_us=rtt_us,
+    )
+
+
+class Harness:
+    def __init__(self, **lm_kwargs):
+        self.fabric = InProcessTransport()
+        self.kv_updates: ReplicateQueue = ReplicateQueue()
+        self.kv_syncs: ReplicateQueue = ReplicateQueue()
+        self.peer_events: ReplicateQueue = ReplicateQueue()
+        self.if_updates: ReplicateQueue = ReplicateQueue()
+        self.nbr_events: ReplicateQueue = ReplicateQueue()
+        self.sync_events: ReplicateQueue = ReplicateQueue()
+        self.nl_events: ReplicateQueue = ReplicateQueue()
+        self.if_reader = self.if_updates.get_reader()
+        self.peer_reader = self.peer_events.get_reader()
+
+        self.kvstore = KvStore(
+            "node1",
+            self.kv_updates,
+            self.kv_syncs,
+            self.peer_events.get_reader(),
+            transport=self.fabric.bind("node1"),
+        )
+        self.fabric.register("node1", self.kvstore)
+        self.kvstore.run()
+
+        self.lm = LinkMonitor(
+            "node1",
+            interface_updates_queue=self.if_updates,
+            peer_updates_queue=self.peer_events,
+            neighbor_updates=self.nbr_events.get_reader(),
+            kvstore_sync_events=self.sync_events.get_reader(),
+            netlink_events=self.nl_events.get_reader(),
+            **lm_kwargs,
+        )
+        self.lm.run()
+        self.client = KvStoreClientInternal(
+            self.lm, "node1", self.kvstore, check_persist_interval_s=60
+        )
+        self.lm.kvstore_client = self.client
+
+    def adj_db(self) -> AdjacencyDatabase | None:
+        raw = self.kvstore.get_key_vals("0", [adj_key("node1")]).key_vals.get(
+            adj_key("node1")
+        )
+        return None if raw is None else loads(raw.value, AdjacencyDatabase)
+
+    def stop(self):
+        for q in (
+            self.kv_updates,
+            self.kv_syncs,
+            self.peer_events,
+            self.if_updates,
+            self.nbr_events,
+            self.sync_events,
+            self.nl_events,
+        ):
+            q.close()
+        self.client.stop()
+        self.lm.stop()
+        self.kvstore.stop()
+        self.lm.wait_until_stopped(5)
+        self.kvstore.wait_until_stopped(5)
+
+
+@pytest.fixture
+def harness():
+    h = Harness()
+    yield h
+    h.stop()
+
+
+def wait_for(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+class TestLinkMonitor:
+    def test_link_event_feeds_spark(self, harness):
+        harness.nl_events.push(LinkEvent("if1", 1, True))
+        db = harness.if_reader.get(timeout=5)
+        assert db.interfaces["if1"].is_up
+        assert db.this_node_name == "node1"
+
+    def test_flap_backoff(self, harness, monkeypatch):
+        harness.nl_events.push(LinkEvent("if1", 1, True))
+        db = harness.if_reader.get(timeout=5)
+        assert db.interfaces["if1"].is_up
+        # flap: down -> up; up must be held back by backoff
+        harness.nl_events.push(LinkEvent("if1", 1, False))
+        db = harness.if_reader.get(timeout=5)
+        assert not db.interfaces["if1"].is_up
+        harness.nl_events.push(LinkEvent("if1", 1, True))
+        db = harness.if_reader.get(timeout=5)
+        assert not db.interfaces["if1"].is_up  # still in backoff
+        # after backoff expires (1s initial) it comes up
+        db = harness.if_reader.get(timeout=5)
+        assert db.interfaces["if1"].is_up
+
+    def test_addr_event_tracks_networks(self, harness):
+        harness.nl_events.push(LinkEvent("if1", 1, True))
+        harness.if_reader.get(timeout=5)
+        harness.nl_events.push(AddrEvent("if1", "fc00::1/128", True))
+        db = harness.if_reader.get(timeout=5)
+        assert db.interfaces["if1"].networks == ["fc00::1/128"]
+        harness.nl_events.push(AddrEvent("if1", "fc00::1/128", False))
+        db = harness.if_reader.get(timeout=5)
+        assert db.interfaces["if1"].networks == []
+
+    def test_neighbor_up_creates_peer_and_gated_adj(self, harness):
+        harness.nbr_events.push(neighbor_up("node2"))
+        peer_event = harness.peer_reader.get(timeout=5)
+        assert "node2" in peer_event.peers_to_add
+        assert peer_event.peers_to_add["node2"].peer_addr == "fe80::node2"
+        # adjacency NOT advertised until initial kvstore sync with the peer
+        time.sleep(0.2)
+        assert harness.adj_db() is None
+        harness.sync_events.push(KvStoreSyncEvent("node2", "0"))
+        assert wait_for(lambda: harness.adj_db() is not None)
+        db = harness.adj_db()
+        assert [a.other_node_name for a in db.adjacencies] == ["node2"]
+        adj = db.adjacencies[0]
+        assert adj.if_name == "if1"
+        assert adj.other_if_name == "if1-r"
+        assert adj.metric == 1
+        assert adj.next_hop_v6 == "fe80::node2"
+
+    def test_neighbor_down_removes_peer_and_adj(self, harness):
+        harness.nbr_events.push(neighbor_up("node2"))
+        harness.peer_reader.get(timeout=5)
+        harness.sync_events.push(KvStoreSyncEvent("node2", "0"))
+        assert wait_for(
+            lambda: (db := harness.adj_db()) is not None and db.adjacencies
+        )
+        harness.nbr_events.push(
+            NeighborEvent(
+                event_type=NeighborEventType.NEIGHBOR_DOWN,
+                node_name="node2",
+                if_name="if1",
+                area="0",
+            )
+        )
+        peer_event = harness.peer_reader.get(timeout=5)
+        assert peer_event.peers_to_del == ["node2"]
+        assert wait_for(
+            lambda: (db := harness.adj_db()) is not None and not db.adjacencies
+        )
+
+    def test_drain_apis(self, harness):
+        harness.nbr_events.push(neighbor_up("node2"))
+        harness.peer_reader.get(timeout=5)
+        harness.sync_events.push(KvStoreSyncEvent("node2", "0"))
+        assert wait_for(lambda: harness.adj_db() is not None)
+
+        harness.lm.set_node_overload(True)
+        assert wait_for(lambda: harness.adj_db().is_overloaded)
+        harness.lm.set_link_overload("if1", True)
+        assert wait_for(
+            lambda: harness.adj_db().adjacencies[0].is_overloaded
+        )
+        harness.lm.set_link_metric("if1", 42)
+        assert wait_for(lambda: harness.adj_db().adjacencies[0].metric == 42)
+        # adj override beats link override
+        harness.lm.set_adj_metric("if1", "node2", 77)
+        assert wait_for(lambda: harness.adj_db().adjacencies[0].metric == 77)
+        harness.lm.set_adj_metric("if1", "node2", None)
+        harness.lm.set_link_metric("if1", None)
+        assert wait_for(lambda: harness.adj_db().adjacencies[0].metric == 1)
+        state = harness.lm.get_state()
+        assert state.is_overloaded and "if1" in state.overloaded_links
+
+    def test_parallel_links_independent(self, harness):
+        """Two links to the same node: each is its own adjacency; the peer
+        survives until the LAST link goes down."""
+        harness.nbr_events.push(neighbor_up("node2", if_name="if1"))
+        harness.peer_reader.get(timeout=5)
+        harness.sync_events.push(KvStoreSyncEvent("node2", "0"))
+        assert wait_for(
+            lambda: (db := harness.adj_db()) is not None and len(db.adjacencies) == 1
+        )
+        harness.nbr_events.push(neighbor_up("node2", if_name="if2"))
+        assert wait_for(lambda: len(harness.adj_db().adjacencies) == 2)
+
+        # drop if1: adjacency shrinks, peer stays
+        harness.nbr_events.push(
+            NeighborEvent(
+                event_type=NeighborEventType.NEIGHBOR_DOWN,
+                node_name="node2",
+                if_name="if1",
+                area="0",
+            )
+        )
+        assert wait_for(lambda: len(harness.adj_db().adjacencies) == 1)
+        assert harness.adj_db().adjacencies[0].if_name == "if2"
+        # drop if2: now the peer goes too
+        harness.nbr_events.push(
+            NeighborEvent(
+                event_type=NeighborEventType.NEIGHBOR_DOWN,
+                node_name="node2",
+                if_name="if2",
+                area="0",
+            )
+        )
+        deadline = time.monotonic() + 5
+        deleted = False
+        while time.monotonic() < deadline and not deleted:
+            ev = harness.peer_reader.get(timeout=5)
+            deleted = "node2" in ev.peers_to_del
+        assert deleted
+
+    def test_rtt_metric(self):
+        h = Harness(enable_rtt_metric=True)
+        try:
+            h.nbr_events.push(neighbor_up("node2", rtt_us=2500))
+            h.peer_reader.get(timeout=5)
+            h.sync_events.push(KvStoreSyncEvent("node2", "0"))
+            assert wait_for(
+                lambda: (db := h.adj_db()) is not None
+                and db.adjacencies
+                and db.adjacencies[0].metric == 25
+            )
+            h.nbr_events.push(
+                NeighborEvent(
+                    event_type=NeighborEventType.NEIGHBOR_RTT_CHANGE,
+                    node_name="node2",
+                    if_name="if1",
+                    area="0",
+                    rtt_us=10000,
+                )
+            )
+            assert wait_for(lambda: h.adj_db().adjacencies[0].metric == 100)
+        finally:
+            h.stop()
+
+    def test_node_label_advertised(self):
+        h = Harness(node_label=101)
+        try:
+            h.nbr_events.push(neighbor_up("node2"))
+            h.peer_reader.get(timeout=5)
+            h.sync_events.push(KvStoreSyncEvent("node2", "0"))
+            assert wait_for(
+                lambda: (db := h.adj_db()) is not None and db.node_label == 101
+            )
+        finally:
+            h.stop()

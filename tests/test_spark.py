@@ -1,0 +1,257 @@
+"""Spark tests: multi-node discovery without a network.
+
+Modeled on the reference's SparkTest.cpp (openr/spark/tests/): each Spark
+gets a MockIoProvider endpoint simulating connected interfaces with
+configurable latency.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from openr_tpu.runtime.queue import ReplicateQueue
+from openr_tpu.spark import (
+    AreaConfig,
+    MockIoProvider,
+    Spark,
+    SparkConfig,
+    SparkNeighState,
+)
+from openr_tpu.types import (
+    InterfaceDatabase,
+    InterfaceInfo,
+    NeighborEvent,
+    NeighborEventType,
+)
+
+FAST_CFG = SparkConfig(
+    hello_time_s=0.2,
+    fastinit_hello_time_s=0.02,
+    keepalive_time_s=0.05,
+    hold_time_s=0.3,
+    graceful_restart_time_s=0.6,
+    negotiate_hold_time_s=0.5,
+)
+
+
+def if_db(node: str, *ifs: str, up: bool = True) -> InterfaceDatabase:
+    return InterfaceDatabase(
+        this_node_name=node,
+        interfaces={
+            name: InterfaceInfo(if_name=name, is_up=up, if_index=i + 1)
+            for i, name in enumerate(ifs)
+        },
+    )
+
+
+class SparkHarness:
+    def __init__(self):
+        self.fabric = MockIoProvider()
+        self.nodes: dict[str, Spark] = {}
+        self.if_queues: dict[str, ReplicateQueue] = {}
+        self.event_readers: dict[str, object] = {}
+
+    def add_node(self, name: str, *, areas=None, config=FAST_CFG, domain="openr"):
+        ifq: ReplicateQueue = ReplicateQueue()
+        nbrq: ReplicateQueue[NeighborEvent] = ReplicateQueue()
+        reader = nbrq.get_reader()
+        spark = Spark(
+            name,
+            ifq.get_reader(),
+            nbrq,
+            self.fabric.endpoint(name),
+            config=config,
+            areas=areas,
+            domain=domain,
+        )
+        spark.run()
+        self.nodes[name] = spark
+        self.if_queues[name] = ifq
+        self.event_readers[name] = reader
+        return spark, reader
+
+    def bring_up(self, node: str, *ifs: str):
+        self.if_queues[node].push(if_db(node, *ifs))
+
+    def next_event(self, node: str, timeout=5.0) -> NeighborEvent:
+        return self.event_readers[node].get(timeout=timeout)
+
+    def wait_event(self, node: str, event_type, timeout=5.0) -> NeighborEvent:
+        deadline = time.monotonic() + timeout
+        while True:
+            ev = self.event_readers[node].get(
+                timeout=max(0.05, deadline - time.monotonic())
+            )
+            if ev.event_type == event_type:
+                return ev
+
+    def stop(self):
+        for q in self.if_queues.values():
+            q.close()
+        for spark in self.nodes.values():
+            spark.stop()
+        for spark in self.nodes.values():
+            spark.wait_until_stopped(5)
+
+
+@pytest.fixture
+def harness():
+    h = SparkHarness()
+    yield h
+    h.stop()
+
+
+class TestSpark:
+    def test_two_nodes_establish(self, harness):
+        harness.add_node("node1")
+        harness.add_node("node2")
+        harness.fabric.connect("node1", "if1", "node2", "if2")
+        harness.bring_up("node1", "if1")
+        harness.bring_up("node2", "if2")
+
+        ev1 = harness.wait_event("node1", NeighborEventType.NEIGHBOR_UP)
+        ev2 = harness.wait_event("node2", NeighborEventType.NEIGHBOR_UP)
+        assert ev1.node_name == "node2" and ev1.if_name == "if1"
+        assert ev2.node_name == "node1" and ev2.if_name == "if2"
+        assert ev1.area == "0" and ev2.area == "0"
+        assert ev1.neighbor_addr_v6 == "fe80::node2"
+        assert (
+            harness.nodes["node1"].get_neigh_state("if1", "node2")
+            == SparkNeighState.ESTABLISHED
+        )
+
+    def test_three_nodes_shared_segment(self, harness):
+        for n in ("a", "b", "c"):
+            harness.add_node(n)
+        harness.fabric.connect("a", "if1", "b", "if1")
+        harness.fabric.connect("a", "if1", "c", "if1")
+        harness.fabric.connect("b", "if1", "c", "if1")
+        for n in ("a", "b", "c"):
+            harness.bring_up(n, "if1")
+        up_a = {
+            harness.wait_event("a", NeighborEventType.NEIGHBOR_UP).node_name
+            for _ in range(2)
+        }
+        assert up_a == {"b", "c"}
+
+    def test_heartbeat_hold_expiry_neighbor_down(self, harness):
+        harness.add_node("node1")
+        harness.add_node("node2")
+        harness.fabric.connect("node1", "if1", "node2", "if2")
+        harness.bring_up("node1", "if1")
+        harness.bring_up("node2", "if2")
+        harness.wait_event("node1", NeighborEventType.NEIGHBOR_UP)
+
+        harness.fabric.disconnect("node1", "if1", "node2", "if2")
+        ev = harness.wait_event("node1", NeighborEventType.NEIGHBOR_DOWN)
+        assert ev.node_name == "node2"
+        assert (
+            harness.nodes["node1"].get_neigh_state("if1", "node2")
+            == SparkNeighState.IDLE
+        )
+
+    def test_interface_down_neighbor_down(self, harness):
+        harness.add_node("node1")
+        harness.add_node("node2")
+        harness.fabric.connect("node1", "if1", "node2", "if2")
+        harness.bring_up("node1", "if1")
+        harness.bring_up("node2", "if2")
+        harness.wait_event("node1", NeighborEventType.NEIGHBOR_UP)
+        harness.wait_event("node2", NeighborEventType.NEIGHBOR_UP)
+
+        # node1 takes if1 down
+        harness.if_queues["node1"].push(if_db("node1"))
+        ev = harness.wait_event("node1", NeighborEventType.NEIGHBOR_DOWN)
+        assert ev.node_name == "node2"
+        # node2 eventually times out too
+        harness.wait_event("node2", NeighborEventType.NEIGHBOR_DOWN)
+
+    def test_graceful_restart(self, harness):
+        harness.add_node("node1")
+        harness.add_node("node2")
+        harness.fabric.connect("node1", "if1", "node2", "if2")
+        harness.bring_up("node1", "if1")
+        harness.bring_up("node2", "if2")
+        harness.wait_event("node2", NeighborEventType.NEIGHBOR_UP)
+
+        harness.nodes["node1"].flood_restarting_msg()
+        ev = harness.wait_event("node2", NeighborEventType.NEIGHBOR_RESTARTING)
+        assert ev.node_name == "node1"
+        assert (
+            harness.nodes["node2"].get_neigh_state("if2", "node1")
+            == SparkNeighState.RESTART
+        )
+
+        # node1 comes back (stop announcing restart) -> RESTARTED
+        harness.nodes["node1"].run_in_event_base_thread(
+            lambda: setattr(harness.nodes["node1"], "_restarting", False)
+        ).result()
+        ev = harness.wait_event("node2", NeighborEventType.NEIGHBOR_RESTARTED)
+        assert ev.node_name == "node1"
+        assert (
+            harness.nodes["node2"].get_neigh_state("if2", "node1")
+            == SparkNeighState.ESTABLISHED
+        )
+
+    def test_gr_expiry_goes_down(self, harness):
+        harness.add_node("node1")
+        harness.add_node("node2")
+        harness.fabric.connect("node1", "if1", "node2", "if2")
+        harness.bring_up("node1", "if1")
+        harness.bring_up("node2", "if2")
+        harness.wait_event("node2", NeighborEventType.NEIGHBOR_UP)
+
+        # node1 announces restart then vanishes entirely
+        harness.nodes["node1"].flood_restarting_msg()
+        harness.wait_event("node2", NeighborEventType.NEIGHBOR_RESTARTING)
+        harness.fabric.disconnect("node1", "if1", "node2", "if2")
+        ev = harness.wait_event("node2", NeighborEventType.NEIGHBOR_DOWN)
+        assert ev.node_name == "node1"
+
+    def test_area_mismatch_no_adjacency(self, harness):
+        harness.add_node(
+            "node1", areas=[AreaConfig(area_id="1", neighbor_regexes=["node2"])]
+        )
+        harness.add_node(
+            "node2", areas=[AreaConfig(area_id="2", neighbor_regexes=["node1"])]
+        )
+        harness.fabric.connect("node1", "if1", "node2", "if2")
+        harness.bring_up("node1", "if1")
+        harness.bring_up("node2", "if2")
+        time.sleep(1.0)
+        assert harness.nodes["node1"].get_neigh_state("if1", "node2") in (
+            SparkNeighState.WARM,
+            SparkNeighState.NEGOTIATE,
+        )
+        with pytest.raises(TimeoutError):
+            harness.event_readers["node1"].get(timeout=0.1)
+
+    def test_domain_mismatch_ignored(self, harness):
+        harness.add_node("node1", domain="d1")
+        harness.add_node("node2", domain="d2")
+        harness.fabric.connect("node1", "if1", "node2", "if2")
+        harness.bring_up("node1", "if1")
+        harness.bring_up("node2", "if2")
+        time.sleep(0.5)
+        assert harness.nodes["node1"].get_neigh_state("if1", "node2") is None
+
+    def test_rtt_measured_with_latency(self, harness):
+        harness.add_node("node1")
+        harness.add_node("node2")
+        # 25ms one-way latency -> ~50ms RTT
+        harness.fabric.connect("node1", "if1", "node2", "if2", latency_s=0.025)
+        harness.bring_up("node1", "if1")
+        harness.bring_up("node2", "if2")
+        harness.wait_event("node1", NeighborEventType.NEIGHBOR_UP, timeout=10)
+
+        deadline = time.monotonic() + 5
+        rtt = 0
+        while time.monotonic() < deadline:
+            neighbors = harness.nodes["node1"].get_neighbors()
+            if neighbors and neighbors[0].rtt_latest_us > 0:
+                rtt = neighbors[0].rtt_latest_us
+                break
+            time.sleep(0.05)
+        assert 30_000 <= rtt <= 200_000, rtt
