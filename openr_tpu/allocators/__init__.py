@@ -1,0 +1,6 @@
+"""Distributed allocators over KvStore consensus."""
+
+from .range_allocator import RangeAllocator
+from .prefix_allocator import PrefixAllocator
+
+__all__ = ["PrefixAllocator", "RangeAllocator"]

@@ -1,0 +1,5 @@
+"""PersistentStore: disk-backed KV surviving restarts."""
+
+from .persistent_store import PersistentObject, PersistentStore
+
+__all__ = ["PersistentObject", "PersistentStore"]

@@ -191,7 +191,7 @@ class LinkMonitor(OpenrEventBase):
     # -- persistence ---------------------------------------------------------
 
     def _load_state(self, assume_drained: bool, override: bool) -> None:
-        loaded = None
+        loaded = False
         if self.config_store is not None:
             raw = self.config_store.load(CONFIG_KEY)
             if raw is not None:
@@ -199,20 +199,29 @@ class LinkMonitor(OpenrEventBase):
                     import json
 
                     d = json.loads(raw.decode())
-                    self.state.is_overloaded = d["is_overloaded"]
-                    self.state.overloaded_links = set(d["overloaded_links"])
-                    self.state.link_metric_overrides = {
+                    # parse completely before applying: a corrupt blob must
+                    # not leave partially-applied state
+                    is_overloaded = bool(d["is_overloaded"])
+                    overloaded_links = set(d["overloaded_links"])
+                    link_metric_overrides = {
                         k: int(v) for k, v in d["link_metric_overrides"].items()
                     }
-                    self.state.node_label = d.get("node_label", 0)
-                    self.state.adj_metric_overrides = {
-                        tuple(k.split("|", 1)): int(v)
-                        for k, v in d.get("adj_metric_overrides", {}).items()
-                    }
+                    node_label = int(d.get("node_label", 0))
+                    adj_metric_overrides = {}
+                    for k, v in d.get("adj_metric_overrides", {}).items():
+                        if_name, _, node = k.partition("|")
+                        if not node:
+                            raise ValueError(f"bad adj key {k!r}")
+                        adj_metric_overrides[(if_name, node)] = int(v)
+                    self.state.is_overloaded = is_overloaded
+                    self.state.overloaded_links = overloaded_links
+                    self.state.link_metric_overrides = link_metric_overrides
+                    self.state.node_label = node_label or self.state.node_label
+                    self.state.adj_metric_overrides = adj_metric_overrides
                     loaded = True
                 except Exception:
                     log.exception("link-monitor: corrupt persisted state")
-        if loaded is None and assume_drained:
+        if not loaded and assume_drained:
             self.state.is_overloaded = True
         if override:
             self.state.is_overloaded = assume_drained

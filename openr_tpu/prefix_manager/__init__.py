@@ -1,0 +1,5 @@
+"""PrefixManager: route origination + cross-area redistribution."""
+
+from .prefix_manager import OriginatedPrefixConfig, PrefixManager
+
+__all__ = ["OriginatedPrefixConfig", "PrefixManager"]

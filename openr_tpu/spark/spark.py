@@ -206,7 +206,9 @@ class Spark(OpenrEventBase):
         self._heartbeat_timers: dict[str, object] = {}
         self._seq_num = 0
         self._restarting = False
+        self._fastinit_rounds: dict[str, int] = {}
         self.counters: dict[str, int] = {}
+        self._max_fastinit_rounds = 10
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -258,6 +260,7 @@ class Spark(OpenrEventBase):
         self.neighbors.setdefault(if_name, {})
         self.io.add_interface(if_name)
         # fast-init hellos solicit immediate responses
+        self._fastinit_rounds[if_name] = 0
         self._schedule_hello(if_name, fastinit=True)
         self._schedule_heartbeat(if_name)
 
@@ -268,14 +271,28 @@ class Spark(OpenrEventBase):
             if timer is not None:
                 timer.cancel()
         for neighbor in list(self.neighbors.get(if_name, {}).values()):
-            if neighbor.state == SparkNeighState.ESTABLISHED:
+            if neighbor.state in (
+                SparkNeighState.ESTABLISHED,
+                SparkNeighState.RESTART,
+            ):
                 self._neighbor_down(neighbor, NeighborEventType.NEIGHBOR_DOWN)
+            else:
+                # disarm orphaned timers so they can't fire against a
+                # future re-established neighbor with the same key
+                for attr in (
+                    "heartbeat_hold_timer",
+                    "negotiate_hold_timer",
+                    "gr_hold_timer",
+                ):
+                    self._cancel_timer(neighbor, attr)
         self.neighbors.pop(if_name, None)
         self.io.remove_interface(if_name)
 
     # -- senders (reference: Spark.h:180-193) --------------------------------
 
     def _schedule_hello(self, if_name: str, fastinit: bool = False) -> None:
+        if if_name not in self._interfaces:
+            return
         existing = self._hello_timers.pop(if_name, None)
         if existing is not None:
             existing.cancel()
@@ -294,11 +311,18 @@ class Spark(OpenrEventBase):
         if if_name not in self._interfaces:
             return
         self.send_hello(if_name)
-        # stay in fastinit until any neighbor is past WARM
-        fastinit = was_fastinit and not any(
-            n.state
-            in (SparkNeighState.NEGOTIATE, SparkNeighState.ESTABLISHED)
-            for n in self.neighbors.get(if_name, {}).values()
+        # stay in fastinit until a neighbor is past WARM, bounded by a
+        # round budget so an idle port decays to the slow hello rate
+        rounds = self._fastinit_rounds.get(if_name, 0) + 1
+        self._fastinit_rounds[if_name] = rounds
+        fastinit = (
+            was_fastinit
+            and rounds < self._max_fastinit_rounds
+            and not any(
+                n.state
+                in (SparkNeighState.NEGOTIATE, SparkNeighState.ESTABLISHED)
+                for n in self.neighbors.get(if_name, {}).values()
+            )
         )
         self._schedule_hello(if_name, fastinit=fastinit)
 
@@ -417,6 +441,7 @@ class Spark(OpenrEventBase):
                 hello.node_name, if_name
             )
             # a brand-new neighbor: restart fast hellos to converge quickly
+            self._fastinit_rounds[if_name] = 0
             self._schedule_hello(if_name, fastinit=True)
 
         neighbor.last_nbr_hello_rcvd_ts_us = recv_ts_us

@@ -1,0 +1,95 @@
+"""PersistentStore tests (modeled on openr/config-store/tests/)."""
+
+from __future__ import annotations
+
+import os
+
+from openr_tpu.config_store import PersistentStore
+from openr_tpu.config_store.persistent_store import (
+    ActionType,
+    PersistentObject,
+    TLV_MARKER,
+    decode_persistent_objects,
+    encode_persistent_object,
+)
+
+
+class TestCodec:
+    def test_roundtrip(self):
+        objs = [
+            PersistentObject(ActionType.ADD, "k1", b"\x00\x01binary"),
+            PersistentObject(ActionType.DEL, "k1"),
+            PersistentObject(ActionType.ADD, "empty", b""),
+        ]
+        blob = b"".join(encode_persistent_object(o) for o in objs)
+        assert decode_persistent_objects(blob) == objs
+
+    def test_truncation_tolerated(self):
+        objs = [
+            PersistentObject(ActionType.ADD, "k1", b"data1"),
+            PersistentObject(ActionType.ADD, "k2", b"data2"),
+        ]
+        blob = b"".join(encode_persistent_object(o) for o in objs)
+        got = decode_persistent_objects(blob[:-3], tolerate_truncation=True)
+        assert got == objs[:1]
+
+
+class TestPersistentStore:
+    def test_store_load_erase(self, tmp_path):
+        path = str(tmp_path / "store.bin")
+        store = PersistentStore(path)
+        store.store("k1", b"v1")
+        store.store("k2", b"v2")
+        assert store.load("k1") == b"v1"
+        assert store.erase("k1") is True
+        assert store.erase("k1") is False
+        assert store.load("k1") is None
+        store.close()
+
+    def test_survives_restart(self, tmp_path):
+        path = str(tmp_path / "store.bin")
+        store = PersistentStore(path)
+        store.store("drain", b"true")
+        store.store("gone", b"x")
+        store.erase("gone")
+        store.store("prefix-index", b"42")
+        store.close()
+
+        store2 = PersistentStore(path)
+        assert store2.load("drain") == b"true"
+        assert store2.load("prefix-index") == b"42"
+        assert store2.load("gone") is None
+        assert store2.keys() == ["drain", "prefix-index"]
+        store2.close()
+
+    def test_full_rewrite_compacts(self, tmp_path):
+        path = str(tmp_path / "store.bin")
+        store = PersistentStore(path)
+        for i in range(50):
+            store.store("churn", f"v{i}".encode())
+        size_before = os.path.getsize(path)
+        assert store.save_database_to_disk()
+        assert os.path.getsize(path) < size_before
+        store.close()
+        store2 = PersistentStore(path)
+        assert store2.load("churn") == b"v49"
+        store2.close()
+
+    def test_torn_append_recovery(self, tmp_path):
+        path = str(tmp_path / "store.bin")
+        store = PersistentStore(path)
+        store.store("good", b"ok")
+        store.close()
+        with open(path, "ab") as f:
+            f.write(b"\x01\xff\xff")  # torn partial record
+        store2 = PersistentStore(path)
+        assert store2.load("good") == b"ok"
+        store2.close()
+
+    def test_dryrun_writes_nothing(self, tmp_path):
+        path = str(tmp_path / "store.bin")
+        store = PersistentStore(path, dryrun=True)
+        store.store("k", b"v")
+        assert store.load("k") == b"v"
+        store.close()
+        assert not os.path.exists(path)

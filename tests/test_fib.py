@@ -1,0 +1,148 @@
+"""Fib tests (modeled on openr/fib/tests/FibTest.cpp): incremental
+programming, failure -> full resync with backoff, agent-restart detection,
+doNotInstall, perf events."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from openr_tpu.decision.rib import DecisionRouteUpdate, RibMplsEntry, RibUnicastEntry
+from openr_tpu.fib import Fib, MockFibAgent, longest_prefix_match
+from openr_tpu.runtime.queue import ReplicateQueue
+from openr_tpu.types import NextHop, PerfEvents
+
+CLIENT = 786
+
+
+def route(prefix: str, nh: str = "fe80::1") -> RibUnicastEntry:
+    return RibUnicastEntry(
+        prefix=prefix, nexthops=frozenset({NextHop(address=nh)})
+    )
+
+
+def update(*routes: RibUnicastEntry, delete=(), mpls=(), mpls_del=(), perf=None):
+    u = DecisionRouteUpdate(perf_events=perf)
+    for r in routes:
+        u.add_route_to_update(r)
+    u.unicast_routes_to_delete.extend(delete)
+    u.mpls_routes_to_update.extend(mpls)
+    u.mpls_routes_to_delete.extend(mpls_del)
+    return u
+
+
+def wait_for(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+@pytest.fixture
+def harness():
+    routeq: ReplicateQueue = ReplicateQueue()
+    fibq: ReplicateQueue = ReplicateQueue()
+    agent = MockFibAgent()
+    fib = Fib(
+        "node1",
+        routeq.get_reader(),
+        agent,
+        fib_updates_queue=fibq,
+        keepalive_interval_s=0.1,
+        sync_initial_backoff_s=0.02,
+        sync_max_backoff_s=0.2,
+    )
+    fib.run()
+    yield routeq, agent, fib, fibq.get_reader()
+    routeq.close()
+    fibq.close()
+    fib.stop()
+    fib.wait_until_stopped(5)
+
+
+class TestLongestPrefixMatch:
+    def test_basic(self):
+        prefixes = ["10.0.0.0/8", "10.1.0.0/16", "10.1.1.0/24", "::/0"]
+        assert longest_prefix_match("10.1.1.5", prefixes) == "10.1.1.0/24"
+        assert longest_prefix_match("10.2.0.1", prefixes) == "10.0.0.0/8"
+        assert longest_prefix_match("2001::1", prefixes) == "::/0"
+        assert longest_prefix_match("192.168.0.1", prefixes) is None
+
+
+class TestFib:
+    def test_initial_sync_then_incremental(self, harness):
+        routeq, agent, fib, _ = harness
+        assert wait_for(lambda: agent.counters["sync_fib"] >= 1)
+        routeq.push(update(route("::1:0/112")))
+        assert wait_for(
+            lambda: "::1:0/112" in agent.unicast.get(CLIENT, {})
+        )
+        assert agent.counters["add_unicast"] == 1
+        # delete
+        routeq.push(update(delete=["::1:0/112"]))
+        assert wait_for(lambda: "::1:0/112" not in agent.unicast.get(CLIENT, {}))
+
+    def test_mpls_programming(self, harness):
+        routeq, agent, fib, _ = harness
+        assert wait_for(lambda: agent.counters["sync_fib"] >= 1)
+        routeq.push(
+            update(
+                mpls=[
+                    RibMplsEntry(
+                        label=100, nexthops=frozenset({NextHop(address="fe80::2")})
+                    )
+                ]
+            )
+        )
+        assert wait_for(lambda: 100 in agent.mpls.get(CLIENT, {}))
+        routeq.push(update(mpls_del=[100]))
+        assert wait_for(lambda: 100 not in agent.mpls.get(CLIENT, {}))
+
+    def test_failure_triggers_resync(self, harness):
+        routeq, agent, fib, _ = harness
+        assert wait_for(lambda: agent.counters["sync_fib"] >= 1)
+        agent.fail = True
+        routeq.push(update(route("::2:0/112")))
+        time.sleep(0.2)
+        assert "::2:0/112" not in agent.unicast.get(CLIENT, {})
+        agent.fail = False
+        # backoff'd syncFib reconciles the full state
+        assert wait_for(lambda: "::2:0/112" in agent.unicast.get(CLIENT, {}))
+
+    def test_agent_restart_resync(self, harness):
+        routeq, agent, fib, _ = harness
+        routeq.push(update(route("::3:0/112")))
+        assert wait_for(lambda: "::3:0/112" in agent.unicast.get(CLIENT, {}))
+        agent.restart()  # wipes table, bumps aliveSince
+        assert wait_for(lambda: "::3:0/112" in agent.unicast.get(CLIENT, {}))
+        assert fib.counters.get("fib.agent_restarts", 0) >= 1
+
+    def test_do_not_install(self, harness):
+        routeq, agent, fib, _ = harness
+        assert wait_for(lambda: agent.counters["sync_fib"] >= 1)
+        r = RibUnicastEntry(
+            prefix="::4:0/112",
+            nexthops=frozenset({NextHop(address="fe80::1")}),
+            do_not_install=True,
+        )
+        routeq.push(update(r))
+        time.sleep(0.2)
+        assert "::4:0/112" not in agent.unicast.get(CLIENT, {})
+        # still tracked in Fib's own state
+        unicast, _mpls = fib.get_route_db()
+        assert any(u.dest == "::4:0/112" for u in unicast)
+
+    def test_perf_events_and_fib_stream(self, harness):
+        routeq, agent, fib, fib_reader = harness
+        assert wait_for(lambda: agent.counters["sync_fib"] >= 1)
+        perf = PerfEvents()
+        perf.add("node1", "DECISION_RECEIVED")
+        routeq.push(update(route("::5:0/112"), perf=perf))
+        programmed = fib_reader.get(timeout=5)
+        names = [e.event_name for e in programmed.perf_events.events]
+        assert names[0] == "DECISION_RECEIVED"
+        assert "OPENR_FIB_ROUTES_PROGRAMMED" in names
+        assert fib.get_perf_db()

@@ -1,0 +1,292 @@
+"""PrefixManager + allocator tests (modeled on
+openr/prefix-manager/tests/PrefixManagerTest.cpp and
+openr/allocators/tests/RangeAllocatorTest.cpp)."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from openr_tpu.allocators import PrefixAllocator, RangeAllocator
+from openr_tpu.decision.rib import DecisionRouteUpdate, RibUnicastEntry
+from openr_tpu.kvstore import InProcessTransport, KvStore, KvStoreClientInternal
+from openr_tpu.prefix_manager import OriginatedPrefixConfig, PrefixManager
+from openr_tpu.runtime.eventbase import OpenrEventBase
+from openr_tpu.runtime.queue import ReplicateQueue
+from openr_tpu.serializer import loads
+from openr_tpu.types import (
+    NextHop,
+    PeerSpec,
+    PrefixDatabase,
+    PrefixEntry,
+    PrefixType,
+    PrefixUpdateRequest,
+    prefix_key,
+)
+
+
+def wait_for(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+class Node:
+    def __init__(self, name: str, fabric: InProcessTransport, areas=("0",)):
+        self.name = name
+        self.updates: ReplicateQueue = ReplicateQueue()
+        self.syncs: ReplicateQueue = ReplicateQueue()
+        self.peerq: ReplicateQueue = ReplicateQueue()
+        self.kvstore = KvStore(
+            name,
+            self.updates,
+            self.syncs,
+            self.peerq.get_reader(),
+            transport=fabric.bind(name),
+            areas=areas,
+        )
+        fabric.register(name, self.kvstore)
+        self.kvstore.run()
+        self.evb = OpenrEventBase(name=f"evb-{name}")
+        self.evb.run()
+        self.client = KvStoreClientInternal(
+            self.evb, name, self.kvstore, self.updates.get_reader(),
+            check_persist_interval_s=60,
+        )
+
+    def stop(self):
+        self.client.stop()
+        for q in (self.updates, self.syncs, self.peerq):
+            q.close()
+        self.evb.stop()
+        self.kvstore.stop()
+        self.evb.wait_until_stopped(5)
+        self.kvstore.wait_until_stopped(5)
+
+
+@pytest.fixture
+def node():
+    fabric = InProcessTransport()
+    n = Node("node1", fabric)
+    yield n
+    n.stop()
+
+
+PFX = "::1:0/112"
+
+
+class TestPrefixManager:
+    def test_advertise_withdraw(self, node):
+        pm = PrefixManager("node1", node.client)
+        pm.run()
+        try:
+            pm.advertise_prefixes(
+                PrefixType.LOOPBACK, [PrefixEntry(prefix=PFX)]
+            )
+            key = prefix_key("node1", PFX, "0")
+            raw = node.kvstore.get_key_vals("0", [key]).key_vals.get(key)
+            assert raw is not None
+            db = loads(raw.value, PrefixDatabase)
+            assert db.prefix_entries[0].prefix == PFX
+            assert not db.delete_prefix
+
+            pm.withdraw_prefixes(PrefixType.LOOPBACK, [PFX])
+            raw = node.kvstore.get_key_vals("0", [key]).key_vals.get(key)
+            db = loads(raw.value, PrefixDatabase)
+            assert db.delete_prefix  # tombstone
+            assert pm.get_prefixes() == []
+        finally:
+            pm.stop()
+            pm.wait_until_stopped(5)
+
+    def test_best_type_wins_single_key(self, node):
+        pm = PrefixManager("node1", node.client)
+        pm.run()
+        try:
+            pm.advertise_prefixes(PrefixType.LOOPBACK, [PrefixEntry(prefix=PFX)])
+            pm.advertise_prefixes(
+                PrefixType.BGP, [PrefixEntry(prefix=PFX, type=PrefixType.BGP)]
+            )
+            key = prefix_key("node1", PFX, "0")
+            raw = node.kvstore.get_key_vals("0", [key]).key_vals[key]
+            db = loads(raw.value, PrefixDatabase)
+            assert db.prefix_entries[0].type == PrefixType.BGP  # higher prio
+            # withdrawing BGP falls back to LOOPBACK
+            pm.withdraw_prefixes(PrefixType.BGP, [PFX])
+            raw = node.kvstore.get_key_vals("0", [key]).key_vals[key]
+            db = loads(raw.value, PrefixDatabase)
+            assert db.prefix_entries[0].type == PrefixType.LOOPBACK
+        finally:
+            pm.stop()
+            pm.wait_until_stopped(5)
+
+    def test_sync_by_type(self, node):
+        pm = PrefixManager("node1", node.client)
+        pm.run()
+        try:
+            pm.advertise_prefixes(
+                PrefixType.CONFIG,
+                [PrefixEntry(prefix="::1:0/112"), PrefixEntry(prefix="::2:0/112")],
+            )
+            pm.sync_prefixes_by_type(
+                PrefixType.CONFIG,
+                [PrefixEntry(prefix="::2:0/112"), PrefixEntry(prefix="::3:0/112")],
+            )
+            prefixes = {e.prefix for e in pm.get_prefixes(PrefixType.CONFIG)}
+            assert prefixes == {"::2:0/112", "::3:0/112"}
+        finally:
+            pm.stop()
+            pm.wait_until_stopped(5)
+
+    def test_queue_driven_requests(self, node):
+        prefixq: ReplicateQueue = ReplicateQueue()
+        pm = PrefixManager(
+            "node1", node.client, prefix_updates=prefixq.get_reader()
+        )
+        pm.run()
+        try:
+            prefixq.push(
+                PrefixUpdateRequest(
+                    prefixes_to_add=[PrefixEntry(prefix=PFX)],
+                    type=PrefixType.LOOPBACK,
+                )
+            )
+            key = prefix_key("node1", PFX, "0")
+            assert wait_for(
+                lambda: node.kvstore.get_key_vals("0", [key]).key_vals.get(key)
+                is not None
+            )
+        finally:
+            prefixq.close()
+            pm.stop()
+            pm.wait_until_stopped(5)
+
+    def test_originated_prefix_aggregation(self, node):
+        routeq: ReplicateQueue = ReplicateQueue()
+        pm = PrefixManager(
+            "node1",
+            node.client,
+            route_updates=routeq.get_reader(),
+            originated_prefixes=[
+                OriginatedPrefixConfig(
+                    prefix="fc00::/16", minimum_supporting_routes=2
+                )
+            ],
+        )
+        pm.run()
+        try:
+            def push_routes(*prefixes, delete=()):
+                u = DecisionRouteUpdate()
+                for p in prefixes:
+                    u.add_route_to_update(
+                        RibUnicastEntry(
+                            prefix=p,
+                            nexthops=frozenset({NextHop(address="fe80::1")}),
+                        )
+                    )
+                u.unicast_routes_to_delete.extend(delete)
+                routeq.push(u)
+
+            push_routes("fc00:1::/32")
+            time.sleep(0.2)
+            assert pm.get_originated_prefixes()["fc00::/16"] == (1, False)
+            push_routes("fc00:2::/32")
+            assert wait_for(
+                lambda: pm.get_originated_prefixes()["fc00::/16"] == (2, True)
+            )
+            key = prefix_key("node1", "fc00::/16", "0")
+            raw = node.kvstore.get_key_vals("0", [key]).key_vals.get(key)
+            assert raw is not None
+            # one supporting route withdrawn -> aggregate withdrawn
+            push_routes(delete=["fc00:2::/32"])
+            assert wait_for(
+                lambda: pm.get_originated_prefixes()["fc00::/16"] == (1, False)
+            )
+        finally:
+            routeq.close()
+            pm.stop()
+            pm.wait_until_stopped(5)
+
+
+class TestRangeAllocator:
+    def test_unique_election(self):
+        """N nodes in a full KvStore mesh elect distinct values."""
+        fabric = InProcessTransport()
+        n_nodes = 4
+        nodes = [Node(f"n{i}", fabric) for i in range(n_nodes)]
+        try:
+            # full-mesh peering
+            for a in nodes:
+                a.kvstore.add_peers(
+                    "0",
+                    {
+                        b.name: PeerSpec(peer_addr=b.name)
+                        for b in nodes
+                        if b is not a
+                    },
+                )
+            allocators = []
+            results: dict[str, int | None] = {}
+            for n in nodes:
+                def cb(value, name=n.name):
+                    results[name] = value
+
+                alloc = RangeAllocator(
+                    n.evb,
+                    n.client,
+                    "0",
+                    "alloc:",
+                    n.name,
+                    cb,
+                    (0, n_nodes - 1),
+                    settle_time_s=0.15,
+                )
+                allocators.append(alloc)
+            for alloc in allocators:
+                alloc.start_allocation()
+            assert wait_for(
+                lambda: len([v for v in results.values() if v is not None])
+                == n_nodes
+                and len({v for v in results.values()}) == n_nodes,
+                timeout=20,
+            ), results
+        finally:
+            for alloc in allocators:
+                alloc.stop()
+            for n in nodes:
+                n.stop()
+
+
+class TestPrefixAllocator:
+    def test_prefix_from_index(self, node, tmp_path):
+        from openr_tpu.config_store import PersistentStore
+
+        store = PersistentStore(str(tmp_path / "store.bin"))
+        prefixq: ReplicateQueue = ReplicateQueue()
+        reader = prefixq.get_reader()
+        alloc = PrefixAllocator(
+            node.evb,
+            "node1",
+            node.client,
+            "fc00::/16",
+            32,
+            prefix_updates_queue=prefixq,
+            config_store=store,
+        )
+        alloc.start()
+        try:
+            req = reader.get(timeout=10)
+            assert req.type == PrefixType.PREFIX_ALLOCATOR
+            got = req.prefixes_to_add[0].prefix
+            assert got.endswith("/32") and got.startswith("fc00:")
+            assert alloc.get_my_prefix() == got
+            # index persisted for restart
+            assert store.load("prefix-allocator-config") is not None
+        finally:
+            alloc.stop()
+            prefixq.close()
+            store.close()
