@@ -1,0 +1,1 @@
+"""breeze: the operator CLI (reference: openr/py/openr/cli/breeze.py)."""

@@ -1,0 +1,419 @@
+"""breeze — operator CLI for openr_tpu.
+
+Functional equivalent of the reference's click-based breeze
+(openr/py/openr/cli/breeze.py + clis/*): per-module command groups over the
+ctrl API.  argparse-based (no third-party CLI dependency).
+
+    breeze [-H host] [-p port] <group> <command> [args]
+
+Groups: kvstore, decision, fib, lm, prefixmgr, spark, monitor, config.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any
+
+from ..ctrl.client import CtrlClient
+from ..serializer import to_wire
+from ..types import (
+    ADJ_MARKER,
+    AdjacencyDatabase,
+    PrefixDatabase,
+    PrefixEntry,
+    PrefixType,
+    PREFIX_MARKER,
+)
+from ..serializer import loads
+
+
+def _print_json(obj: Any) -> None:
+    print(json.dumps(to_wire(obj), indent=2, sort_keys=True))
+
+
+def _table(rows: list[list[str]], header: list[str]) -> None:
+    widths = [
+        max(len(str(r[i])) for r in rows + [header]) for i in range(len(header))
+    ]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    print(fmt.format(*header))
+    print(fmt.format(*["-" * w for w in widths]))
+    for row in rows:
+        print(fmt.format(*[str(c) for c in row]))
+
+
+# -- command implementations -------------------------------------------------
+
+
+def cmd_kvstore_keys(client: CtrlClient, args) -> None:
+    pub = client.call(
+        "getKvStoreKeyValsFilteredArea",
+        area=args.area,
+        prefixes=[args.prefix] if args.prefix else [],
+        hash_only=True,
+    )
+    rows = [
+        [k, v.originator_id, v.version, v.ttl_version, v.ttl_ms]
+        for k, v in sorted(pub.key_vals.items())
+    ]
+    _table(rows, ["Key", "Originator", "Version", "TTL Version", "TTL (ms)"])
+
+
+def cmd_kvstore_keyvals(client: CtrlClient, args) -> None:
+    pub = client.call("getKvStoreKeyValsArea", area=args.area, keys=args.keys)
+    for key, val in sorted(pub.key_vals.items()):
+        print(f"> {key}")
+        if val.value is None:
+            print("  (no value)")
+            continue
+        try:
+            _print_json(loads(val.value))
+        except Exception:
+            print(f"  {val.value!r}")
+
+
+def cmd_kvstore_peers(client: CtrlClient, args) -> None:
+    peers = client.call("getKvStorePeersArea", area=args.area)
+    rows = [
+        [name, spec.peer_addr, spec.ctrl_port, spec.state.name]
+        for name, spec in sorted(peers.items())
+    ]
+    _table(rows, ["Peer", "Address", "Port", "State"])
+
+
+def cmd_kvstore_summary(client: CtrlClient, args) -> None:
+    _print_json(client.call("getKvStoreAreaSummary"))
+
+
+def cmd_kvstore_snoop(client: CtrlClient, args) -> None:
+    """Stream KvStore deltas (reference: KvStoreSnooper tool)."""
+    for pub in client.stream(
+        "subscribeKvStore", area=args.area, prefixes=args.prefixes or []
+    ):
+        for key, val in sorted(pub.key_vals.items()):
+            print(f"UPDATE {key} v={val.version} from={val.originator_id}")
+        for key in pub.expired_keys:
+            print(f"EXPIRE {key}")
+
+
+def cmd_decision_routes(client: CtrlClient, args) -> None:
+    db = client.call("getRouteDb", node=args.node)
+    print(f"== Unicast Routes ({len(db.unicast_routes)}) ==")
+    for prefix, entry in sorted(db.unicast_routes.items()):
+        print(f"> {prefix}")
+        for nh in sorted(entry.nexthops, key=lambda n: n.address):
+            label = f" mpls {nh.mpls_action.action.name}" if nh.mpls_action else ""
+            print(
+                f"  via {nh.address}%{nh.if_name} metric {nh.metric}{label}"
+            )
+    if db.mpls_routes:
+        print(f"== MPLS Routes ({len(db.mpls_routes)}) ==")
+        for label, entry in sorted(db.mpls_routes.items()):
+            nhs = ", ".join(
+                f"{nh.address}({nh.mpls_action.action.name if nh.mpls_action else '-'})"
+                for nh in sorted(entry.nexthops, key=lambda n: n.address)
+            )
+            print(f"> {label} via {nhs}")
+
+
+def cmd_decision_adj(client: CtrlClient, args) -> None:
+    dbs = client.call(
+        "getDecisionAdjacenciesFiltered", areas=[args.area] if args.area else None
+    )
+    rows = []
+    for db in sorted(dbs, key=lambda d: d.this_node_name):
+        for adj in db.adjacencies:
+            rows.append(
+                [
+                    db.this_node_name,
+                    adj.other_node_name,
+                    adj.if_name,
+                    adj.metric,
+                    "overloaded" if db.is_overloaded else "",
+                ]
+            )
+    _table(rows, ["Node", "Neighbor", "Interface", "Metric", "Flags"])
+
+
+def cmd_decision_received_routes(client: CtrlClient, args) -> None:
+    _print_json(client.call("getReceivedRoutesFiltered", prefixes=args.prefixes))
+
+
+def cmd_decision_path(client: CtrlClient, args) -> None:
+    """Client-side path computation over adj DBs (reference:
+    breeze decision path, openr/py/openr/cli/commands/decision.py:293)."""
+    from ..decision.link_state import LinkState
+
+    dbs = client.call("getDecisionAdjacenciesFiltered", areas=None)
+    ls = LinkState(area=dbs[0].area if dbs else "0")
+    for db in dbs:
+        ls.update_adjacency_database(db)
+    src = args.src or client.call("getMyNodeName")
+    result = ls.get_spf_result(src)
+    if args.dst not in result:
+        print(f"no path from {src} to {args.dst}")
+        sys.exit(1)
+    # walk one shortest path backwards
+    hops = [args.dst]
+    node = args.dst
+    while node != src:
+        node = result[node].path_links[0][1]
+        hops.append(node)
+    hops.reverse()
+    print(
+        f"path from {src} to {args.dst} (metric {result[args.dst].metric}): "
+        + " -> ".join(hops)
+    )
+
+
+def cmd_fib_routes(client: CtrlClient, args) -> None:
+    db = client.call("getRouteDbFib")
+    for route in sorted(db["unicastRoutes"], key=lambda r: r.dest):
+        nhs = ", ".join(
+            f"{nh.address}%{nh.if_name}" for nh in route.next_hops
+        )
+        print(f"{route.dest} via {nhs}")
+    for route in sorted(db["mplsRoutes"], key=lambda r: r.top_label):
+        print(f"label {route.top_label} nexthops {len(route.next_hops)}")
+
+
+def cmd_fib_perf(client: CtrlClient, args) -> None:
+    for perf in client.call("getPerfDb"):
+        print(f"== convergence {perf.total_duration_ms()}ms ==")
+        base = perf.events[0].unix_ts_ms if perf.events else 0
+        for event in perf.events:
+            print(f"  {event.event_name:<32} +{event.unix_ts_ms - base}ms")
+
+
+def cmd_lm_links(client: CtrlClient, args) -> None:
+    interfaces = client.call("getInterfaces")
+    rows = [
+        [name, "UP" if info.is_up else "DOWN", info.if_index, ",".join(info.networks)]
+        for name, info in sorted(interfaces.items())
+    ]
+    _table(rows, ["Interface", "Status", "Index", "Addresses"])
+    state = client.call("getLinkMonitorState")
+    print(f"\nnode overloaded: {state['is_overloaded']}")
+    if state["overloaded_links"]:
+        print(f"overloaded links: {', '.join(state['overloaded_links'])}")
+    if state["link_metric_overrides"]:
+        print(f"metric overrides: {state['link_metric_overrides']}")
+
+
+def cmd_lm_set_node_overload(client: CtrlClient, args) -> None:
+    client.call("setNodeOverload")
+    print("node overload set")
+
+
+def cmd_lm_unset_node_overload(client: CtrlClient, args) -> None:
+    client.call("unsetNodeOverload")
+    print("node overload unset")
+
+
+def cmd_lm_set_link_overload(client: CtrlClient, args) -> None:
+    client.call("setInterfaceOverload", interface=args.interface)
+    print(f"link overload set on {args.interface}")
+
+
+def cmd_lm_unset_link_overload(client: CtrlClient, args) -> None:
+    client.call("unsetInterfaceOverload", interface=args.interface)
+    print(f"link overload unset on {args.interface}")
+
+
+def cmd_lm_set_link_metric(client: CtrlClient, args) -> None:
+    client.call(
+        "setInterfaceMetric", interface=args.interface, metric=args.metric
+    )
+    print(f"metric {args.metric} set on {args.interface}")
+
+
+def cmd_lm_unset_link_metric(client: CtrlClient, args) -> None:
+    client.call("unsetInterfaceMetric", interface=args.interface)
+    print(f"metric override removed from {args.interface}")
+
+
+def cmd_prefixmgr_view(client: CtrlClient, args) -> None:
+    entries = client.call("getPrefixes")
+    rows = [
+        [
+            e.prefix,
+            e.type.name,
+            e.forwarding_type.name,
+            e.forwarding_algorithm.name,
+        ]
+        for e in sorted(entries, key=lambda e: e.prefix)
+    ]
+    _table(rows, ["Prefix", "Type", "Forwarding", "Algorithm"])
+
+
+def cmd_prefixmgr_advertise(client: CtrlClient, args) -> None:
+    client.call(
+        "advertisePrefixes",
+        type=PrefixType[args.type],
+        prefixes=[PrefixEntry(prefix=p, type=PrefixType[args.type]) for p in args.prefixes],
+    )
+    print(f"advertised {len(args.prefixes)} prefixes")
+
+
+def cmd_prefixmgr_withdraw(client: CtrlClient, args) -> None:
+    client.call(
+        "withdrawPrefixes", type=PrefixType[args.type], prefixes=args.prefixes
+    )
+    print(f"withdrew {len(args.prefixes)} prefixes")
+
+
+def cmd_prefixmgr_originated(client: CtrlClient, args) -> None:
+    _print_json(client.call("getOriginatedPrefixes"))
+
+
+def cmd_spark_neighbors(client: CtrlClient, args) -> None:
+    neighbors = client.call("getSparkNeighbors")
+    rows = [
+        [
+            n["nodeName"],
+            n["state"],
+            n["ifName"],
+            n["remoteIfName"],
+            n["area"],
+            n["rttUs"],
+        ]
+        for n in neighbors
+    ]
+    _table(rows, ["Neighbor", "State", "Local If", "Remote If", "Area", "RTT (us)"])
+
+
+def cmd_monitor_counters(client: CtrlClient, args) -> None:
+    counters = (
+        client.call("getRegexCounters", regex=args.regex)
+        if args.regex
+        else client.call("getCounters")
+    )
+    for key in sorted(counters):
+        print(f"{key} : {counters[key]}")
+
+
+def cmd_config(client: CtrlClient, args) -> None:
+    _print_json(client.call("getRunningConfig"))
+
+
+def cmd_version(client: CtrlClient, args) -> None:
+    _print_json(client.call("getOpenrVersion"))
+
+
+# -- parser ------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="breeze", description=__doc__)
+    parser.add_argument("-H", "--host", default="::1")
+    parser.add_argument("-p", "--port", type=int, default=2018)
+    sub = parser.add_subparsers(dest="group", required=True)
+
+    kv = sub.add_parser("kvstore").add_subparsers(dest="cmd", required=True)
+    p = kv.add_parser("keys")
+    p.add_argument("--prefix", default="")
+    p.add_argument("--area", default="0")
+    p.set_defaults(fn=cmd_kvstore_keys)
+    p = kv.add_parser("keyvals")
+    p.add_argument("keys", nargs="+")
+    p.add_argument("--area", default="0")
+    p.set_defaults(fn=cmd_kvstore_keyvals)
+    p = kv.add_parser("peers")
+    p.add_argument("--area", default="0")
+    p.set_defaults(fn=cmd_kvstore_peers)
+    p = kv.add_parser("summary")
+    p.set_defaults(fn=cmd_kvstore_summary)
+    p = kv.add_parser("snoop")
+    p.add_argument("--area", default="0")
+    p.add_argument("--prefixes", nargs="*")
+    p.set_defaults(fn=cmd_kvstore_snoop)
+
+    dec = sub.add_parser("decision").add_subparsers(dest="cmd", required=True)
+    p = dec.add_parser("routes")
+    p.add_argument("--node", default="")
+    p.set_defaults(fn=cmd_decision_routes)
+    p = dec.add_parser("adj")
+    p.add_argument("--area", default="")
+    p.set_defaults(fn=cmd_decision_adj)
+    p = dec.add_parser("received-routes")
+    p.add_argument("prefixes", nargs="*")
+    p.set_defaults(fn=cmd_decision_received_routes)
+    p = dec.add_parser("path")
+    p.add_argument("--src", default="")
+    p.add_argument("dst")
+    p.set_defaults(fn=cmd_decision_path)
+
+    fib = sub.add_parser("fib").add_subparsers(dest="cmd", required=True)
+    p = fib.add_parser("routes")
+    p.set_defaults(fn=cmd_fib_routes)
+    p = fib.add_parser("perf")
+    p.set_defaults(fn=cmd_fib_perf)
+
+    lm = sub.add_parser("lm").add_subparsers(dest="cmd", required=True)
+    p = lm.add_parser("links")
+    p.set_defaults(fn=cmd_lm_links)
+    p = lm.add_parser("set-node-overload")
+    p.set_defaults(fn=cmd_lm_set_node_overload)
+    p = lm.add_parser("unset-node-overload")
+    p.set_defaults(fn=cmd_lm_unset_node_overload)
+    p = lm.add_parser("set-link-overload")
+    p.add_argument("interface")
+    p.set_defaults(fn=cmd_lm_set_link_overload)
+    p = lm.add_parser("unset-link-overload")
+    p.add_argument("interface")
+    p.set_defaults(fn=cmd_lm_unset_link_overload)
+    p = lm.add_parser("set-link-metric")
+    p.add_argument("interface")
+    p.add_argument("metric", type=int)
+    p.set_defaults(fn=cmd_lm_set_link_metric)
+    p = lm.add_parser("unset-link-metric")
+    p.add_argument("interface")
+    p.set_defaults(fn=cmd_lm_unset_link_metric)
+
+    pm = sub.add_parser("prefixmgr").add_subparsers(dest="cmd", required=True)
+    p = pm.add_parser("view")
+    p.set_defaults(fn=cmd_prefixmgr_view)
+    p = pm.add_parser("advertise")
+    p.add_argument("prefixes", nargs="+")
+    p.add_argument("--type", default="BREEZE")
+    p.set_defaults(fn=cmd_prefixmgr_advertise)
+    p = pm.add_parser("withdraw")
+    p.add_argument("prefixes", nargs="+")
+    p.add_argument("--type", default="BREEZE")
+    p.set_defaults(fn=cmd_prefixmgr_withdraw)
+    p = pm.add_parser("originated")
+    p.set_defaults(fn=cmd_prefixmgr_originated)
+
+    spark = sub.add_parser("spark").add_subparsers(dest="cmd", required=True)
+    p = spark.add_parser("neighbors")
+    p.set_defaults(fn=cmd_spark_neighbors)
+
+    mon = sub.add_parser("monitor").add_subparsers(dest="cmd", required=True)
+    p = mon.add_parser("counters")
+    p.add_argument("--regex", default="")
+    p.set_defaults(fn=cmd_monitor_counters)
+
+    p = sub.add_parser("config")
+    p.set_defaults(fn=cmd_config)
+    p = sub.add_parser("version")
+    p.set_defaults(fn=cmd_version)
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    client = CtrlClient(args.host, args.port)
+    try:
+        args.fn(client, args)
+        return 0
+    except ConnectionError as e:
+        print(f"cannot reach ctrl server at [{args.host}]:{args.port}: {e}")
+        return 1
+    finally:
+        client.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

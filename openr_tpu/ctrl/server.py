@@ -1,0 +1,508 @@
+"""Ctrl server: NDJSON-RPC over TCP with server streaming.
+
+Wire protocol (one JSON object per line):
+    request:   {"id": N, "method": "...", "params": {...}}
+    response:  {"id": N, "result": <wire-encoded>}
+             | {"id": N, "error": "..."}
+    streaming: {"id": N, "stream": <item>} ... ; client sends
+               {"id": N, "cancel": true} to stop.
+
+Dataclass values are wire-tagged via serializer.to_wire/from_wire.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import fnmatch
+import json
+import logging
+import re
+from typing import Any, Callable, Optional
+
+from ..runtime.eventbase import OpenrEventBase
+from ..runtime.queue import QueueClosedError, ReplicateQueue
+from ..serializer import from_wire, to_wire
+from ..types import ADJ_MARKER, Publication
+
+log = logging.getLogger(__name__)
+
+OPENR_VERSION = 20
+OPENR_LOWEST_SUPPORTED_VERSION = 20
+
+
+class CtrlError(RuntimeError):
+    pass
+
+
+class OpenrCtrlHandler:
+    """Method registry over the module set (reference:
+    OpenrCtrlHandler.h:53 — raw pointers to every module)."""
+
+    def __init__(
+        self,
+        node_name: str,
+        *,
+        kvstore=None,
+        decision=None,
+        fib=None,
+        link_monitor=None,
+        prefix_manager=None,
+        spark=None,
+        monitor=None,
+        config=None,
+        kvstore_updates_queue: Optional[ReplicateQueue[Publication]] = None,
+        fib_updates_queue: Optional[ReplicateQueue] = None,
+    ) -> None:
+        self.node_name = node_name
+        self.kvstore = kvstore
+        self.decision = decision
+        self.fib = fib
+        self.link_monitor = link_monitor
+        self.prefix_manager = prefix_manager
+        self.spark = spark
+        self.monitor = monitor
+        self.config = config
+        self.kvstore_updates_queue = kvstore_updates_queue
+        self.fib_updates_queue = fib_updates_queue
+        self.methods: dict[str, Callable[[dict], Any]] = {}
+        self._register_methods()
+
+    def _need(self, module, name: str):
+        if module is None:
+            raise CtrlError(f"module {name} not available")
+        return module
+
+    def _register_methods(self) -> None:
+        m = self.methods
+        # -- meta ------------------------------------------------------------
+        m["getMyNodeName"] = lambda p: self.node_name
+        m["getOpenrVersion"] = lambda p: {
+            "version": OPENR_VERSION,
+            "lowestSupportedVersion": OPENR_LOWEST_SUPPORTED_VERSION,
+        }
+        m["getRunningConfig"] = lambda p: (
+            self.config.to_dict() if self.config is not None else {}
+        )
+        m["getCounters"] = lambda p: self._all_counters()
+        m["getRegexCounters"] = lambda p: {
+            k: v
+            for k, v in self._all_counters().items()
+            if re.search(p["regex"], k)
+        }
+
+        # -- kvstore ----------------------------------------------------------
+        m["getKvStoreKeyValsArea"] = lambda p: self._need(
+            self.kvstore, "kvstore"
+        ).get_key_vals(p.get("area", "0"), p["keys"])
+        m["getKvStoreKeyValsFilteredArea"] = self._kvstore_dump_filtered
+        m["getKvStoreHashFilteredArea"] = lambda p: self._need(
+            self.kvstore, "kvstore"
+        ).dump_hashes(p.get("area", "0"), p.get("prefixes", []))
+        m["setKvStoreKeyVals"] = self._kvstore_set
+        m["getKvStorePeersArea"] = lambda p: self._need(
+            self.kvstore, "kvstore"
+        ).dump_peers(p.get("area", "0"))
+        m["getKvStoreAreaSummary"] = self._kvstore_summary
+
+        # -- decision ---------------------------------------------------------
+        m["getRouteDb"] = lambda p: self._need(
+            self.decision, "decision"
+        ).get_route_db(p.get("node", ""))
+        m["getDecisionAdjacenciesFiltered"] = lambda p: self._need(
+            self.decision, "decision"
+        ).get_adjacency_databases(
+            set(p["areas"]) if p.get("areas") else None
+        )
+        m["getReceivedRoutesFiltered"] = lambda p: self._need(
+            self.decision, "decision"
+        ).get_received_routes(
+            prefixes=p.get("prefixes"),
+            node_name=p.get("node"),
+            area_name=p.get("area"),
+        )
+        m["setRibPolicy"] = lambda p: self._need(
+            self.decision, "decision"
+        ).set_rib_policy(p["policy"])
+        m["getRibPolicy"] = lambda p: self._need(
+            self.decision, "decision"
+        ).get_rib_policy()
+        m["clearRibPolicy"] = lambda p: self._need(
+            self.decision, "decision"
+        ).clear_rib_policy()
+
+        # -- fib --------------------------------------------------------------
+        m["getRouteDbFib"] = self._fib_route_db
+        m["getUnicastRoutesFiltered"] = lambda p: self._need(
+            self.fib, "fib"
+        ).get_unicast_routes(p.get("prefixes"))
+        m["getPerfDb"] = lambda p: self._need(self.fib, "fib").get_perf_db()
+
+        # -- link-monitor -----------------------------------------------------
+        lm = lambda: self._need(self.link_monitor, "link-monitor")  # noqa: E731
+        m["getInterfaces"] = lambda p: lm().get_interfaces()
+        m["getLinkMonitorAdjacenciesFiltered"] = lambda p: lm().get_adjacencies(
+            p.get("area", "0")
+        )
+        m["getLinkMonitorState"] = lambda p: self._lm_state()
+        m["setNodeOverload"] = lambda p: lm().set_node_overload(True)
+        m["unsetNodeOverload"] = lambda p: lm().set_node_overload(False)
+        m["setInterfaceOverload"] = lambda p: lm().set_link_overload(
+            p["interface"], True
+        )
+        m["unsetInterfaceOverload"] = lambda p: lm().set_link_overload(
+            p["interface"], False
+        )
+        m["setInterfaceMetric"] = lambda p: lm().set_link_metric(
+            p["interface"], p["metric"]
+        )
+        m["unsetInterfaceMetric"] = lambda p: lm().set_link_metric(
+            p["interface"], None
+        )
+        m["setAdjacencyMetric"] = lambda p: lm().set_adj_metric(
+            p["interface"], p["node"], p["metric"]
+        )
+        m["unsetAdjacencyMetric"] = lambda p: lm().set_adj_metric(
+            p["interface"], p["node"], None
+        )
+
+        # -- prefix-manager ---------------------------------------------------
+        pm = lambda: self._need(self.prefix_manager, "prefix-manager")  # noqa: E731
+        m["advertisePrefixes"] = lambda p: pm().advertise_prefixes(
+            p["type"], p["prefixes"]
+        )
+        m["withdrawPrefixes"] = lambda p: pm().withdraw_prefixes(
+            p["type"], [e.prefix if hasattr(e, "prefix") else e for e in p["prefixes"]]
+        )
+        m["syncPrefixesByType"] = lambda p: pm().sync_prefixes_by_type(
+            p["type"], p["prefixes"]
+        )
+        m["getPrefixes"] = lambda p: pm().get_prefixes()
+        m["getPrefixesByType"] = lambda p: pm().get_prefixes(p["type"])
+        m["getOriginatedPrefixes"] = lambda p: pm().get_originated_prefixes()
+
+        # -- spark ------------------------------------------------------------
+        m["getSparkNeighbors"] = self._spark_neighbors
+
+    # -- non-lambda handlers --------------------------------------------------
+
+    def _all_counters(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for module in (
+            self.kvstore,
+            self.decision,
+            self.fib,
+            self.link_monitor,
+            self.prefix_manager,
+            self.spark,
+            self.monitor,
+        ):
+            if module is None:
+                continue
+            get = getattr(module, "get_counters", None)
+            if callable(get):
+                out.update(get())
+            elif hasattr(module, "counters"):
+                out.update(module.counters)
+        return out
+
+    def _kvstore_dump_filtered(self, p: dict) -> Any:
+        from ..kvstore.kvstore import KeyDumpParams
+
+        kvstore = self._need(self.kvstore, "kvstore")
+        area = p.get("area", "0")
+        if p.get("match_all") or p.get("hash_only"):
+            # display-oriented dump variants (no 3-way semantics)
+            return kvstore.dump_all(
+                area,
+                key_prefixes=p.get("prefixes", []),
+                originator_ids=p.get("originators", []),
+                match_all=p.get("match_all", False),
+                do_not_publish_value=p.get("hash_only", False),
+            )
+        # the same path the in-process peer transport uses (3-way diff when
+        # key_val_hashes is present, remaining-TTL adjustment always)
+        return kvstore.process_full_dump(
+            area,
+            KeyDumpParams(
+                keys=p.get("prefixes", []),
+                originator_ids=p.get("originators", []),
+                key_val_hashes=p.get("key_val_hashes"),
+            ),
+        )
+
+    def _kvstore_set(self, p: dict) -> None:
+        kvstore = self._need(self.kvstore, "kvstore")
+        kvstore.set_key_vals(
+            p.get("area", "0"),
+            p["key_vals"],
+            node_ids=p.get("node_ids"),
+        )
+
+    def _kvstore_summary(self, p: dict) -> list[dict]:
+        kvstore = self._need(self.kvstore, "kvstore")
+        out = []
+        for area in kvstore.areas:
+            pub = kvstore.dump_all(area)
+            out.append(
+                {
+                    "area": area,
+                    "keyValsCount": len(pub.key_vals),
+                    "keyValsBytes": sum(
+                        len(v.value or b"") for v in pub.key_vals.values()
+                    ),
+                    "peersCount": len(kvstore.dump_peers(area)),
+                }
+            )
+        return out
+
+    def _lm_state(self) -> dict:
+        state = self._need(self.link_monitor, "link-monitor").get_state()
+        return {
+            "is_overloaded": state.is_overloaded,
+            "overloaded_links": sorted(state.overloaded_links),
+            "link_metric_overrides": dict(state.link_metric_overrides),
+            "node_label": state.node_label,
+            "adj_metric_overrides": {
+                f"{if_name}|{node}": metric
+                for (if_name, node), metric in state.adj_metric_overrides.items()
+            },
+        }
+
+    def _fib_route_db(self, p: dict) -> dict:
+        fib = self._need(self.fib, "fib")
+        unicast, mpls = fib.get_route_db()
+        return {"unicastRoutes": unicast, "mplsRoutes": mpls}
+
+    def _spark_neighbors(self, p: dict) -> list[dict]:
+        spark = self._need(self.spark, "spark")
+        return [
+            {
+                "nodeName": n.node_name,
+                "ifName": n.if_name,
+                "remoteIfName": n.remote_if_name,
+                "state": n.state.name,
+                "area": n.area,
+                "rttUs": n.rtt_us,
+                "transportAddressV6": n.transport_addr_v6,
+                "openrCtrlThriftPort": n.ctrl_port,
+            }
+            for n in spark.get_neighbors()
+        ]
+
+
+class CtrlServer(OpenrEventBase):
+    """TCP server event base (reference: ThriftServer setup,
+    openr/Main.cpp:546-612; deliberately few worker threads — handlers
+    marshal onto the owning modules)."""
+
+    def __init__(
+        self,
+        handler: OpenrCtrlHandler,
+        host: str = "::1",
+        port: int = 2018,
+    ) -> None:
+        super().__init__(name="ctrl-server")
+        self.handler = handler
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    def run(self) -> None:
+        super().run()
+        self.wait_until_running()
+        fut = self.run_coroutine(self._start())
+        fut.result(timeout=10)
+
+    async def _start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port
+        )
+        if self.port == 0:  # ephemeral: record the real port
+            self.port = self._server.sockets[0].getsockname()[1]
+
+    def stop(self) -> None:
+        if self._server is not None and self._loop is not None:
+            server, self._server = self._server, None
+
+            def _close() -> None:
+                server.close()
+
+            try:
+                self.run_in_event_base_thread(_close).result(timeout=5)
+            except Exception:
+                pass
+        super().stop()
+
+    async def _handle_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        streams: dict[int, asyncio.Task] = {}
+        write_lock = asyncio.Lock()
+
+        async def send(obj: dict) -> None:
+            async with write_lock:
+                writer.write(json.dumps(obj).encode() + b"\n")
+                await writer.drain()
+
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                try:
+                    msg = json.loads(line)
+                except json.JSONDecodeError:
+                    await send({"id": None, "error": "bad json"})
+                    continue
+                if not isinstance(msg, dict):
+                    await send({"id": None, "error": "bad request"})
+                    continue
+                msg_id = msg.get("id")
+                if msg.get("cancel"):
+                    task = streams.pop(msg_id, None)
+                    if task is not None:
+                        task.cancel()
+                    continue
+                method = msg.get("method", "")
+                try:
+                    params = from_wire(msg.get("params") or {})
+                except Exception as e:  # bad payload must not kill the conn
+                    await send(
+                        {"id": msg_id, "error": f"bad params: {e}"}
+                    )
+                    continue
+                if method == "subscribeKvStore":
+                    streams[msg_id] = asyncio.ensure_future(
+                        self._stream_kvstore(msg_id, params, send)
+                    )
+                    self._track(streams[msg_id])
+                elif method == "subscribeFib":
+                    streams[msg_id] = asyncio.ensure_future(
+                        self._stream_fib(msg_id, params, send)
+                    )
+                    self._track(streams[msg_id])
+                elif method == "longPollKvStoreAdjArea":
+                    streams[msg_id] = asyncio.ensure_future(
+                        self._long_poll_adj(msg_id, params, send)
+                    )
+                    self._track(streams[msg_id])
+                else:
+                    await self._dispatch(msg_id, method, params, send)
+        except (ConnectionResetError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            for task in streams.values():
+                task.cancel()
+            writer.close()
+
+    async def _dispatch(self, msg_id, method, params, send) -> None:
+        fn = self.handler.methods.get(method)
+        if fn is None:
+            await send({"id": msg_id, "error": f"unknown method {method!r}"})
+            return
+        try:
+            # module APIs block on cross-thread futures: keep them off the
+            # server loop
+            result = await asyncio.get_running_loop().run_in_executor(
+                None, fn, params
+            )
+            await send({"id": msg_id, "result": to_wire(result)})
+        except Exception as e:  # noqa: BLE001
+            log.debug("ctrl: %s failed", method, exc_info=True)
+            await send({"id": msg_id, "error": f"{type(e).__name__}: {e}"})
+
+    # -- streaming (reference: OpenrCtrlHandler.h:240-273) --------------------
+
+    async def _stream_kvstore(self, msg_id, params, send) -> None:
+        """subscribeAndGetKvStore: snapshot + filtered delta stream."""
+        queue = self.handler.kvstore_updates_queue
+        if queue is None:
+            await send({"id": msg_id, "error": "kvstore stream unavailable"})
+            return
+        area = params.get("area", "0")
+        prefixes = params.get("prefixes") or []
+        reader = queue.get_reader()
+        try:
+            if self.handler.kvstore is not None:
+                snapshot = self.handler.kvstore.dump_all(
+                    area, key_prefixes=prefixes
+                )
+                await send({"id": msg_id, "stream": to_wire(snapshot)})
+            while True:
+                pub = await reader.aget()
+                if pub.area != area:
+                    continue
+                if prefixes:
+                    filtered = Publication(
+                        key_vals={
+                            k: v
+                            for k, v in pub.key_vals.items()
+                            if any(k.startswith(p) for p in prefixes)
+                        },
+                        expired_keys=[
+                            k
+                            for k in pub.expired_keys
+                            if any(k.startswith(p) for p in prefixes)
+                        ],
+                        area=pub.area,
+                    )
+                    if not filtered.key_vals and not filtered.expired_keys:
+                        continue
+                    pub = filtered
+                await send({"id": msg_id, "stream": to_wire(pub)})
+        except (QueueClosedError, asyncio.CancelledError, ConnectionResetError):
+            pass
+        finally:
+            queue.close_reader(reader)
+
+    async def _stream_fib(self, msg_id, params, send) -> None:
+        queue = self.handler.fib_updates_queue
+        if queue is None:
+            await send({"id": msg_id, "error": "fib stream unavailable"})
+            return
+        reader = queue.get_reader()
+        try:
+            while True:
+                update = await reader.aget()
+                await send({"id": msg_id, "stream": to_wire(update)})
+        except (QueueClosedError, asyncio.CancelledError, ConnectionResetError):
+            pass
+        finally:
+            queue.close_reader(reader)
+
+    async def _long_poll_adj(self, msg_id, params, send) -> None:
+        """longPollKvStoreAdjArea: resolve when any adj: key changes beyond
+        the client's snapshot (reference: OpenrCtrlHandler.h:269)."""
+        queue = self.handler.kvstore_updates_queue
+        if queue is None:
+            await send({"id": msg_id, "error": "kvstore stream unavailable"})
+            return
+        area = params.get("area", "0")
+        snapshot: dict[str, int] = params.get("snapshot") or {}
+        reader = queue.get_reader()
+        try:
+            # immediate resolution if current state already differs
+            if self.handler.kvstore is not None:
+                current = self.handler.kvstore.dump_all(
+                    area, key_prefixes=[ADJ_MARKER]
+                )
+                for key, val in current.key_vals.items():
+                    if snapshot.get(key) != val.version:
+                        await send({"id": msg_id, "result": True})
+                        return
+            while True:
+                pub = await reader.aget()
+                if pub.area != area:
+                    continue
+                changed = any(
+                    k.startswith(ADJ_MARKER)
+                    and snapshot.get(k) != v.version
+                    for k, v in pub.key_vals.items()
+                ) or any(k.startswith(ADJ_MARKER) for k in pub.expired_keys)
+                if changed:
+                    await send({"id": msg_id, "result": True})
+                    return
+        except (QueueClosedError, asyncio.CancelledError, ConnectionResetError):
+            pass
+        finally:
+            queue.close_reader(reader)

@@ -10,6 +10,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..serializer import register_type
 from ..types import (
     MplsRoute,
     NextHop,
@@ -20,6 +21,7 @@ from ..types import (
 )
 
 
+@register_type
 @dataclass(slots=True)
 class RibUnicastEntry:
     """Reference: RibUnicastEntry (openr/decision/RibEntry.h:38-100)."""
@@ -55,6 +57,7 @@ class RibUnicastEntry:
         )
 
 
+@register_type
 @dataclass(slots=True)
 class RibMplsEntry:
     """Reference: RibMplsEntry (openr/decision/RibEntry.h:102-145)."""
@@ -79,6 +82,7 @@ def _nh_sort_key(nh: NextHop):
     )
 
 
+@register_type
 @dataclass(slots=True)
 class DecisionRouteUpdate:
     """Delta published by Decision, consumed by Fib / PrefixManager / plugin
@@ -105,6 +109,7 @@ class DecisionRouteUpdate:
         )
 
 
+@register_type
 @dataclass(slots=True)
 class DecisionRouteDb:
     """Computed route state (reference: DecisionRouteDb,

@@ -11,10 +11,12 @@ from __future__ import annotations
 import time
 from dataclasses import dataclass, field, replace
 
+from ..serializer import register_type
 from ..types import normalize_prefix
 from .rib import RibUnicastEntry
 
 
+@register_type
 @dataclass(slots=True)
 class RibRouteActionWeight:
     """Reference: thrift::RibRouteActionWeight (OpenrCtrl.thrift:95)."""
@@ -24,6 +26,7 @@ class RibRouteActionWeight:
     neighbor_to_weight: dict[str, int] = field(default_factory=dict)
 
 
+@register_type
 @dataclass(slots=True)
 class RibPolicyStatementConfig:
     """Reference: thrift::RibPolicyStatement (OpenrCtrl.thrift:120)."""
@@ -34,6 +37,7 @@ class RibPolicyStatementConfig:
     set_weight: RibRouteActionWeight | None = None
 
 
+@register_type
 @dataclass(slots=True)
 class RibPolicyConfig:
     """Reference: thrift::RibPolicy (OpenrCtrl.thrift:140)."""

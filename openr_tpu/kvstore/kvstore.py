@@ -970,6 +970,13 @@ class KvStore(OpenrEventBase):
         filters = KvStoreFilters(key_prefixes)
         return self._call(lambda: self._db(area).dump_hash_with_filters(filters))
 
+    def process_full_dump(self, area: str, params: KeyDumpParams) -> Publication:
+        """Serve a peer/ctrl full-dump request (incl. 3-way diff + TTL
+        adjustment) — the same path the in-process transport uses."""
+        return self._call(
+            lambda: self._db(area).process_full_dump_request(params)
+        )
+
     def add_peers(self, area: str, peers: dict[str, PeerSpec]) -> None:
         self._call(lambda: self._db(area).add_peers(peers))
 

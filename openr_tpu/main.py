@@ -1,0 +1,354 @@
+"""Composition root: build and run the full daemon.
+
+Functional equivalent of the reference's main() (openr/Main.cpp:165-688):
+create the replicate queues, start every module in dependency order, wire
+the ctrl server over all of them, and tear down in reverse order.
+
+`OpenrDaemon` is both the daemon entry (`python -m openr_tpu.main --config
+cfg.json`) and the in-process multi-node test harness (the OpenrWrapper
+pattern, openr/tests/OpenrWrapper.h:38): pass a MockIoProvider endpoint and
+an in-process KvStore fabric to run N daemons in one process with no
+network or kernel.
+
+Queue wiring (reference: Main.cpp:275-287; SURVEY §1 dataflow):
+
+    netlink -> netlinkEventsQueue ----------------> LinkMonitor
+    LinkMonitor -> interfaceUpdatesQueue ---------> Spark
+    Spark -> neighborUpdatesQueue ----------------> LinkMonitor
+    LinkMonitor -> peerUpdatesQueue --------------> KvStore
+    LinkMonitor/allocator -> prefixUpdatesQueue --> PrefixManager
+    PrefixManager/LinkMonitor -> (client) --------> KvStore
+    KvStore -> kvStoreUpdatesQueue ---------------> Decision, clients
+    KvStore -> kvStoreSyncEventsQueue ------------> LinkMonitor
+    Decision -> routeUpdatesQueue ----------------> Fib, PrefixManager
+    Fib -> fibUpdatesQueue -----------------------> ctrl streaming
+    everyone -> logSampleQueue -------------------> Monitor
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+from typing import Optional
+
+from .config import OpenrConfig, load_config
+from .ctrl import CtrlServer, OpenrCtrlHandler, TcpKvStoreTransport
+from .decision.decision import Decision
+from .decision.spf_solver import DeviceSpfBackend, SpfBackend
+from .fib import Fib, FibAgent, MockFibAgent
+from .config_store import PersistentStore
+from .kvstore import KvStore, KvStoreClientInternal, KvStoreFilters
+from .link_monitor import LinkMonitor
+from .monitor import Monitor, Watchdog
+from .prefix_manager import PrefixManager
+from .allocators import PrefixAllocator
+from .runtime.queue import ReplicateQueue
+from .spark import IoProvider, Spark, UdpIoProvider
+
+log = logging.getLogger(__name__)
+
+
+class OpenrDaemon:
+    def __init__(
+        self,
+        config: OpenrConfig,
+        *,
+        io_provider: Optional[IoProvider] = None,
+        kvstore_transport=None,
+        fib_agent: Optional[FibAgent] = None,
+        netlink_events_queue: Optional[ReplicateQueue] = None,
+        spf_backend: Optional[SpfBackend] = None,
+        use_device_spf: bool = False,
+        ctrl_port: Optional[int] = None,
+        spark_v6_addr: str = "",
+    ) -> None:
+        self.config = config
+        name = config.node_name
+        areas = config.area_ids
+
+        # -- queues (reference: Main.cpp:275-287) ----------------------------
+        self.kvstore_updates_queue: ReplicateQueue = ReplicateQueue()
+        self.kvstore_sync_events_queue: ReplicateQueue = ReplicateQueue()
+        self.interface_updates_queue: ReplicateQueue = ReplicateQueue()
+        self.neighbor_updates_queue: ReplicateQueue = ReplicateQueue()
+        self.peer_updates_queue: ReplicateQueue = ReplicateQueue()
+        self.prefix_updates_queue: ReplicateQueue = ReplicateQueue()
+        self.route_updates_queue: ReplicateQueue = ReplicateQueue()
+        self.static_routes_queue: ReplicateQueue = ReplicateQueue()
+        self.fib_updates_queue: ReplicateQueue = ReplicateQueue()
+        self.log_sample_queue: ReplicateQueue = ReplicateQueue()
+        self.netlink_events_queue = netlink_events_queue or ReplicateQueue()
+        self._queues = [
+            self.kvstore_updates_queue,
+            self.kvstore_sync_events_queue,
+            self.interface_updates_queue,
+            self.neighbor_updates_queue,
+            self.peer_updates_queue,
+            self.prefix_updates_queue,
+            self.route_updates_queue,
+            self.static_routes_queue,
+            self.fib_updates_queue,
+            self.log_sample_queue,
+        ]
+
+        # -- watchdog (reference: Main.cpp:295-300) --------------------------
+        self.watchdog: Optional[Watchdog] = None
+        if config.enable_watchdog:
+            wc = config.watchdog_config
+            self.watchdog = Watchdog(
+                interval_s=wc.interval_s,
+                thread_timeout_s=wc.thread_timeout_s,
+                max_memory_bytes=wc.max_memory_mb * 1024 * 1024,
+            )
+
+        # -- config store (reference: Main.cpp:370-375) ----------------------
+        self.config_store = PersistentStore(
+            config.persistent_config_store_path or f"/tmp/openr_tpu_{name}.bin",
+            dryrun=not config.persistent_config_store_path,
+        )
+
+        # -- monitor ---------------------------------------------------------
+        self.monitor = Monitor(name, self.log_sample_queue.get_reader())
+
+        # -- kvstore (reference: Main.cpp:389-408) ---------------------------
+        kvc = config.kvstore_config
+        self.kvstore = KvStore(
+            name,
+            self.kvstore_updates_queue,
+            self.kvstore_sync_events_queue,
+            self.peer_updates_queue.get_reader(),
+            transport=kvstore_transport
+            or TcpKvStoreTransport(default_port=config.openr_ctrl_port),
+            areas=areas,
+            filters=(
+                KvStoreFilters(kvc.key_prefix_filters)
+                if kvc.key_prefix_filters
+                else None
+            ),
+            flood_rate=(
+                (kvc.flood_msg_per_sec, kvc.flood_msg_burst_size)
+                if kvc.flood_msg_per_sec > 0
+                else None
+            ),
+            ttl_decr_ms=kvc.ttl_decrement_ms,
+        )
+
+        # -- spark (reference: Main.cpp:443-456) -----------------------------
+        self.io_provider = io_provider or UdpIoProvider()
+        self.spark = Spark(
+            name,
+            self.interface_updates_queue.get_reader(),
+            self.neighbor_updates_queue,
+            self.io_provider,
+            config=config.spark_timers(),
+            areas=config.spark_area_configs(),
+            domain=config.domain,
+            ctrl_port=ctrl_port or config.openr_ctrl_port,
+            v6_addr=spark_v6_addr,
+        )
+
+        # -- link monitor (reference: Main.cpp:458-478) ----------------------
+        lmc = config.link_monitor_config
+        self.link_monitor = LinkMonitor(
+            name,
+            interface_updates_queue=self.interface_updates_queue,
+            peer_updates_queue=self.peer_updates_queue,
+            prefix_updates_queue=self.prefix_updates_queue,
+            neighbor_updates=self.neighbor_updates_queue.get_reader(),
+            kvstore_sync_events=self.kvstore_sync_events_queue.get_reader(),
+            netlink_events=self.netlink_events_queue.get_reader(),
+            config_store=self.config_store,
+            areas=areas,
+            node_label=config.node_label,
+            enable_rtt_metric=lmc.use_rtt_metric,
+            include_if_regexes=tuple(lmc.include_interface_regexes),
+            exclude_if_regexes=tuple(lmc.exclude_interface_regexes),
+            redistribute_if_regexes=tuple(lmc.redistribute_interface_regexes),
+            assume_drained=config.assume_drained,
+            override_drain_state=config.override_drain_state,
+        )
+
+        # -- decision (reference: Main.cpp:518-531) --------------------------
+        backend = spf_backend or (DeviceSpfBackend() if use_device_spf else None)
+        dc = config.decision_config
+        self.decision = Decision(
+            name,
+            self.kvstore_updates_queue.get_reader(),
+            self.static_routes_queue.get_reader(),
+            self.route_updates_queue,
+            debounce_min_s=dc.debounce_min_ms / 1000.0,
+            debounce_max_s=dc.debounce_max_ms / 1000.0,
+            eor_time_s=config.eor_time_s,
+            enable_v4=config.enable_v4,
+            enable_ordered_fib=config.enable_ordered_fib_programming,
+            enable_best_route_selection=config.enable_best_route_selection,
+            enable_rib_policy=config.enable_rib_policy,
+            spf_backend=backend,
+        )
+
+        # -- fib (reference: Main.cpp:533-545) -------------------------------
+        self.fib_agent = fib_agent or MockFibAgent()
+        self.fib = Fib(
+            name,
+            self.route_updates_queue.get_reader(),
+            self.fib_agent,
+            fib_updates_queue=self.fib_updates_queue,
+            log_sample_queue=self.log_sample_queue,
+            dryrun=config.dryrun,
+            enable_segment_routing=config.enable_segment_routing,
+        )
+
+        # modules created after start(): client-dependent ones
+        self.kvstore_client: Optional[KvStoreClientInternal] = None
+        self.prefix_manager: Optional[PrefixManager] = None
+        self.prefix_allocator: Optional[PrefixAllocator] = None
+        self.ctrl_server: Optional[CtrlServer] = None
+        self._ctrl_port_override = ctrl_port
+        self._started = False
+
+    # -- lifecycle (reference: Main.cpp startup order + reverse teardown) ----
+
+    def start(self) -> None:
+        assert not self._started
+        self._started = True
+        modules = [self.monitor, self.kvstore, self.spark, self.link_monitor]
+        for module in modules:
+            module.run()
+            if self.watchdog is not None:
+                self.watchdog.add_evb(module)
+
+        # kvstore client lives on the link-monitor evb (its main user)
+        self.kvstore_client = KvStoreClientInternal(
+            self.link_monitor,
+            self.config.node_name,
+            self.kvstore,
+            self.kvstore_updates_queue.get_reader(),
+        )
+        self.link_monitor.kvstore_client = self.kvstore_client
+
+        self.prefix_manager = PrefixManager(
+            self.config.node_name,
+            self.kvstore_client,
+            prefix_updates=self.prefix_updates_queue.get_reader(),
+            route_updates=self.route_updates_queue.get_reader(),
+            areas=self.config.area_ids,
+        )
+        self.prefix_manager.run()
+
+        if self.config.prefix_allocation_config is not None:
+            pac = self.config.prefix_allocation_config
+            self.prefix_allocator = PrefixAllocator(
+                self.link_monitor,
+                self.config.node_name,
+                self.kvstore,
+                pac.seed_prefix,
+                pac.allocate_prefix_len,
+                area=self.config.area_ids[0],
+                prefix_updates_queue=self.prefix_updates_queue,
+                config_store=self.config_store,
+            )
+            self.prefix_allocator.start()
+
+        # decision AFTER kvstore/link-monitor so SPF sees self
+        # (reference: Main.cpp:518 comment)
+        self.decision.run()
+        self.fib.run()
+        for module in (self.prefix_manager, self.decision, self.fib):
+            if self.watchdog is not None:
+                self.watchdog.add_evb(module)
+
+        handler = OpenrCtrlHandler(
+            self.config.node_name,
+            kvstore=self.kvstore,
+            decision=self.decision,
+            fib=self.fib,
+            link_monitor=self.link_monitor,
+            prefix_manager=self.prefix_manager,
+            spark=self.spark,
+            monitor=self.monitor,
+            config=self.config,
+            kvstore_updates_queue=self.kvstore_updates_queue,
+            fib_updates_queue=self.fib_updates_queue,
+        )
+        self.ctrl_server = CtrlServer(
+            handler,
+            host=self.config.listen_addr,
+            port=(
+                self._ctrl_port_override
+                if self._ctrl_port_override is not None
+                else self.config.openr_ctrl_port
+            ),
+        )
+        self.ctrl_server.run()
+        if self.watchdog is not None:
+            self.watchdog.add_evb(self.ctrl_server)
+            self.watchdog.start()
+
+    @property
+    def ctrl_port(self) -> int:
+        assert self.ctrl_server is not None
+        return self.ctrl_server.port
+
+    def stop(self) -> None:
+        """Reverse-order teardown (reference: Main.cpp:617-668)."""
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        for queue in self._queues:
+            queue.close()
+        modules = [
+            self.ctrl_server,
+            self.fib,
+            self.decision,
+            self.prefix_manager,
+            self.link_monitor,
+            self.spark,
+            self.kvstore,
+            self.monitor,
+        ]
+        if self.prefix_allocator is not None:
+            self.prefix_allocator.stop()
+        if self.kvstore_client is not None:
+            self.kvstore_client.stop()
+        for module in modules:
+            if module is not None:
+                module.stop()
+        for module in modules:
+            if module is not None:
+                module.wait_until_stopped(5)
+        self.config_store.close()
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description="openr_tpu daemon")
+    parser.add_argument("--config", required=True, help="JSON config file")
+    parser.add_argument(
+        "--use-device-spf",
+        action="store_true",
+        help="use the batched TPU SPF backend",
+    )
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    config = load_config(args.config)
+    daemon = OpenrDaemon(config, use_device_spf=args.use_device_spf)
+    daemon.start()
+    log.info(
+        "openr_tpu %s up; ctrl on [%s]:%d",
+        config.node_name,
+        config.listen_addr,
+        daemon.ctrl_port,
+    )
+    stop_event = threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: stop_event.set())
+    signal.signal(signal.SIGTERM, lambda *a: stop_event.set())
+    stop_event.wait()
+    daemon.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

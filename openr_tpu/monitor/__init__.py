@@ -1,0 +1,6 @@
+"""Monitor + Watchdog: observability and liveness."""
+
+from .monitor import LogSample, Monitor, SystemMetrics
+from .watchdog import Watchdog
+
+__all__ = ["LogSample", "Monitor", "SystemMetrics", "Watchdog"]

@@ -1,0 +1,90 @@
+"""Watchdog: module-thread liveness + memory guard.
+
+Functional equivalent of the reference's Watchdog
+(openr/watchdog/Watchdog.{h,cpp}:24-122): every module event base is
+registered (`add_evb`, wired in startEventBase — Main.cpp:153); the
+watchdog thread samples each module's heartbeat timestamp and the process
+RSS, and fires a crash (os.abort for supervisor restart — or a callback in
+tests) on thread stall or memory explosion.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from ..runtime.eventbase import OpenrEventBase
+from .monitor import SystemMetrics
+
+log = logging.getLogger(__name__)
+
+
+class Watchdog:
+    def __init__(
+        self,
+        *,
+        interval_s: float = 20.0,
+        thread_timeout_s: float = 300.0,
+        max_memory_bytes: int = 800 * 1024 * 1024,
+        on_crash: Optional[Callable[[str], None]] = None,
+    ) -> None:
+        self._interval_s = interval_s
+        self._thread_timeout_s = thread_timeout_s
+        self._max_memory_bytes = max_memory_bytes
+        self._on_crash = on_crash
+        self._evbs: list[OpenrEventBase] = []
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.fired: Optional[str] = None
+
+    def add_evb(self, evb: OpenrEventBase) -> None:
+        """Reference: Watchdog::addEvb (Watchdog.h:32)."""
+        with self._lock:
+            self._evbs.append(evb)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, name="watchdog")
+        self._thread.daemon = True
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval_s):
+            self.check_once()
+
+    def check_once(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            evbs = list(self._evbs)
+        for evb in evbs:
+            if not evb.is_running:
+                continue
+            stall = now - evb.get_timestamp()
+            if stall > self._thread_timeout_s:
+                self._fire_crash(
+                    f"thread {evb.name!r} stalled for {stall:.0f}s"
+                )
+                return
+        rss = SystemMetrics.rss_bytes()
+        if rss is not None and rss > self._max_memory_bytes:
+            self._fire_crash(
+                f"memory limit exceeded: rss={rss} > {self._max_memory_bytes}"
+            )
+
+    def _fire_crash(self, reason: str) -> None:
+        """Reference: Watchdog::fireCrash (Watchdog.cpp:110-122) — abort so
+        the supervisor (systemd) restarts the daemon."""
+        log.critical("watchdog: %s", reason)
+        self.fired = reason
+        if self._on_crash is not None:
+            self._on_crash(reason)
+        else:
+            os.abort()

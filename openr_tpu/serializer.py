@@ -36,7 +36,19 @@ def _to_jsonable(obj: Any) -> Any:
         return {str(k): _to_jsonable(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
         return [_to_jsonable(v) for v in obj]
+    if isinstance(obj, (set, frozenset)):
+        return sorted((_to_jsonable(v) for v in obj), key=repr)
     raise TypeError(f"cannot serialize {type(obj)!r}")
+
+
+def _key_from_str(cls: Any, key: str) -> Any:
+    """Reverse the str() applied to dict keys on encode (JSON object keys
+    are always strings; dict[int, ...] fields must round-trip)."""
+    if cls is int:
+        return int(key)
+    if isinstance(cls, type) and issubclass(cls, enum.Enum):
+        return cls(int(key))
+    return key
 
 
 def _from_jsonable(cls: Any, data: Any) -> Any:
@@ -48,12 +60,18 @@ def _from_jsonable(cls: Any, data: Any) -> Any:
     if origin is not None:
         args = cls.__args__
         if origin is dict:
-            return {k: _from_jsonable(args[1], v) for k, v in data.items()}
+            return {
+                _key_from_str(args[0], k): _from_jsonable(args[1], v)
+                for k, v in data.items()
+            }
         if origin is list:
             return [_from_jsonable(args[0], v) for v in data]
         if origin is tuple:
             elem = args[0] if args else Any
             return tuple(_from_jsonable(elem, v) for v in data)
+        if origin in (set, frozenset):
+            elem = args[0] if args else Any
+            return origin(_from_jsonable(elem, v) for v in data)
         # Optional[X] / unions: try each member
         for arg in args:
             if arg is type(None):
@@ -96,3 +114,53 @@ def loads(data: bytes, expected: Type[T_] | None = None) -> T_:
     if expected is not None and cls is not expected:
         raise TypeError(f"expected {expected.__name__}, got {payload['__type__']}")
     return _from_jsonable(cls, payload["d"])
+
+
+def register_type(cls: type) -> type:
+    """Register an out-of-module dataclass for wire (de)serialization.
+    Usable as a decorator."""
+    _TYPE_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+# -- generic RPC value encoding (ctrl server wire format) -------------------
+#
+# Unlike dumps/loads (single known dataclass), RPC params/results are
+# arbitrary compositions: dataclasses are tagged {"!t": TypeName, "!d": ...}
+# so the receiver can reconstruct them without schema context.
+
+
+_SENTINEL_KEYS = frozenset({"!t", "!d", "!m", "__bytes__"})
+
+
+def to_wire(obj: Any) -> Any:
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {"!t": type(obj).__name__, "!d": _to_jsonable(obj)}
+    if isinstance(obj, enum.Enum):
+        return int(obj.value)
+    if isinstance(obj, bytes):
+        return {"__bytes__": obj.hex()}
+    if isinstance(obj, dict):
+        encoded = {str(k): to_wire(v) for k, v in obj.items()}
+        if _SENTINEL_KEYS.intersection(encoded):
+            # user data collides with encoding sentinels: wrap unambiguously
+            return {"!m": encoded}
+        return encoded
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        return [to_wire(v) for v in obj]
+    return obj
+
+
+def from_wire(data: Any) -> Any:
+    if isinstance(data, dict):
+        if "!t" in data:
+            cls = _TYPE_REGISTRY[data["!t"]]
+            return _from_jsonable(cls, data["!d"])
+        if "!m" in data:
+            return {k: from_wire(v) for k, v in data["!m"].items()}
+        if "__bytes__" in data:
+            return bytes.fromhex(data["__bytes__"])
+        return {k: from_wire(v) for k, v in data.items()}
+    if isinstance(data, list):
+        return [from_wire(v) for v in data]
+    return data

@@ -1,0 +1,247 @@
+"""Whole-system multi-node tests.
+
+Modeled on the reference's OpenrSystemTest.cpp + OpenrWrapper
+(openr/tests/OpenrSystemTest.cpp:245 SimpleRingTopologyFixture): N full
+daemons in one process wired through a MockIoProvider fabric, asserting
+cross-node route convergence; plus a two-daemon test over REAL TCP (ctrl
+servers as the KvStore transport) exercised end-to-end through the breeze
+CLI.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import time
+
+import pytest
+
+from openr_tpu.cli import breeze
+from openr_tpu.config import (
+    AreaConf,
+    DecisionConf,
+    OpenrConfig,
+    SparkConf,
+    config_from_dict,
+)
+from openr_tpu.ctrl import CtrlClient
+from openr_tpu.kvstore import InProcessTransport
+from openr_tpu.main import OpenrDaemon
+from openr_tpu.spark import MockIoProvider
+from openr_tpu.types import LinkEvent, PrefixEntry, PrefixType, normalize_prefix
+
+FIB_CLIENT = 786
+
+FAST_SPARK = SparkConf(
+    hello_time_s=0.3,
+    fastinit_hello_time_ms=20,
+    keepalive_time_s=0.05,
+    hold_time_s=0.5,
+    graceful_restart_time_s=1.0,
+)
+
+
+def make_config(name: str, ctrl_port: int = 0) -> OpenrConfig:
+    return OpenrConfig(
+        node_name=name,
+        areas=[AreaConf()],
+        openr_ctrl_port=ctrl_port,
+        spark_config=FAST_SPARK,
+        decision_config=DecisionConf(debounce_min_ms=5, debounce_max_ms=20),
+        enable_watchdog=False,
+        node_label=0,
+    ).validate()
+
+
+def wait_for(cond, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+class RingFixture:
+    """N daemons in a ring over mock fabrics (reference:
+    SimpleRingTopologyFixture)."""
+
+    def __init__(self, n: int):
+        self.spark_fabric = MockIoProvider()
+        self.kv_fabric = InProcessTransport()
+        self.daemons: list[OpenrDaemon] = []
+        for i in range(n):
+            name = f"openr-{i}"
+            addr = f"fe80::{name}"
+            daemon = OpenrDaemon(
+                make_config(name),
+                io_provider=self.spark_fabric.endpoint(name),
+                kvstore_transport=self.kv_fabric.bind(addr),
+                spark_v6_addr=addr,
+            )
+            self.kv_fabric.register(addr, daemon.kvstore)
+            self.daemons.append(daemon)
+        for daemon in self.daemons:
+            daemon.start()
+        # ring links via mock fabric + netlink link events
+        for i in range(n):
+            j = (i + 1) % n
+            if n == 2 and i == 1:
+                break  # single link for a 2-ring
+            self.spark_fabric.connect(
+                f"openr-{i}", f"if-{i}-{j}", f"openr-{j}", f"if-{j}-{i}"
+            )
+        for i in range(n):
+            j, k = (i + 1) % n, (i - 1) % n
+            daemon = self.daemons[i]
+            daemon.netlink_events_queue.push(LinkEvent(f"if-{i}-{j}", 1, True))
+            if n > 2 or i == 0:
+                daemon.netlink_events_queue.push(
+                    LinkEvent(f"if-{i}-{k}", 2, True)
+                )
+
+    def prefix_exists(self, daemon: OpenrDaemon, prefix: str) -> bool:
+        table = daemon.fib_agent.unicast.get(FIB_CLIENT, {})
+        return normalize_prefix(prefix) in table
+
+    def stop(self):
+        for daemon in self.daemons:
+            daemon.stop()
+
+
+@pytest.fixture
+def ring3():
+    fixture = RingFixture(3)
+    yield fixture
+    fixture.stop()
+
+
+class TestRingConvergence:
+    def test_three_node_ring(self, ring3):
+        daemons = ring3.daemons
+        # every node advertises a loopback prefix
+        for i, daemon in enumerate(daemons):
+            daemon.prefix_manager.advertise_prefixes(
+                PrefixType.LOOPBACK,
+                [PrefixEntry(prefix=f"fc00:{i}::/64")],
+            )
+        # every node programs routes to every OTHER node's prefix
+        for i, daemon in enumerate(daemons):
+            for j in range(len(daemons)):
+                if i == j:
+                    continue
+                assert wait_for(
+                    lambda d=daemon, p=f"fc00:{j}::/64": ring3.prefix_exists(d, p)
+                ), f"node {i} missing route to fc00:{j}::/64"
+
+    def test_link_failure_reroutes(self, ring3):
+        daemons = ring3.daemons
+        daemons[1].prefix_manager.advertise_prefixes(
+            PrefixType.LOOPBACK, [PrefixEntry(prefix="fc00:1::/64")]
+        )
+        assert wait_for(lambda: ring3.prefix_exists(daemons[0], "fc00:1::/64"))
+
+        # direct link 0-1 dies: route must survive via node 2
+        ring3.spark_fabric.disconnect("openr-0", "if-0-1", "openr-1", "if-1-0")
+        deadline = time.monotonic() + 20
+
+        def rerouted() -> bool:
+            table = daemons[0].fib_agent.unicast.get(FIB_CLIENT, {})
+            route = table.get(normalize_prefix("fc00:1::/64"))
+            if route is None:
+                return False
+            return {nh.neighbor_node_name for nh in route.next_hops} == {
+                "openr-2"
+            }
+
+        assert wait_for(rerouted), daemons[0].fib_agent.unicast
+
+    def test_drain_node_diverts_traffic(self, ring3):
+        daemons = ring3.daemons
+        daemons[1].prefix_manager.advertise_prefixes(
+            PrefixType.LOOPBACK, [PrefixEntry(prefix="fc00:1::/64")]
+        )
+        assert wait_for(lambda: ring3.prefix_exists(daemons[2], "fc00:1::/64"))
+        # node 0 drains: node 2 must reach node 1 directly, not via 0
+        daemons[0].link_monitor.set_node_overload(True)
+
+        def direct_only() -> bool:
+            table = daemons[2].fib_agent.unicast.get(FIB_CLIENT, {})
+            route = table.get("fc00:1::/64")
+            return route is not None and {
+                nh.neighbor_node_name for nh in route.next_hops
+            } == {"openr-1"}
+
+        assert wait_for(direct_only)
+
+
+class TestTcpSystem:
+    """Two daemons over REAL TCP: ctrl servers double as the KvStore peer
+    transport; driven end-to-end through the breeze CLI."""
+
+    @pytest.fixture
+    def pair(self):
+        spark_fabric = MockIoProvider()
+        ports = (28018, 28019)
+        daemons = []
+        for i, port in enumerate(ports):
+            name = f"tcp-{i}"
+            daemon = OpenrDaemon(
+                make_config(name, ctrl_port=port),
+                io_provider=spark_fabric.endpoint(name),
+                spark_v6_addr="::1",
+            )
+            daemon.start()
+            daemons.append(daemon)
+        spark_fabric.connect("tcp-0", "veth0", "tcp-1", "veth1")
+        daemons[0].netlink_events_queue.push(LinkEvent("veth0", 1, True))
+        daemons[1].netlink_events_queue.push(LinkEvent("veth1", 1, True))
+        yield daemons, ports
+        for daemon in daemons:
+            daemon.stop()
+
+    def breeze(self, port: int, *argv: str) -> str:
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            rc = breeze.main(["-p", str(port), *argv])
+        assert rc == 0, out.getvalue()
+        return out.getvalue()
+
+    def test_tcp_convergence_and_cli(self, pair):
+        daemons, ports = pair
+        daemons[1].prefix_manager.advertise_prefixes(
+            PrefixType.LOOPBACK, [PrefixEntry(prefix="fc01::/64")]
+        )
+        assert wait_for(
+            lambda: "fc01::/64"
+            in daemons[0].fib_agent.unicast.get(FIB_CLIENT, {}),
+            timeout=30,
+        )
+
+        # breeze against daemon 0
+        out = self.breeze(ports[0], "kvstore", "peers")
+        assert "tcp-1" in out and "INITIALIZED" in out
+        out = self.breeze(ports[0], "kvstore", "keys")
+        assert "adj:tcp-0" in out and "prefix:[tcp-1]" in out
+        out = self.breeze(ports[0], "decision", "routes")
+        assert "fc01::/64" in out
+        out = self.breeze(ports[0], "decision", "adj")
+        assert "tcp-0" in out and "tcp-1" in out
+        out = self.breeze(ports[0], "fib", "routes")
+        assert "fc01::/64" in out
+        out = self.breeze(ports[0], "spark", "neighbors")
+        assert "tcp-1" in out and "ESTABLISHED" in out
+        out = self.breeze(ports[0], "decision", "path", "tcp-1")
+        assert "tcp-0 -> tcp-1" in out
+        out = self.breeze(ports[0], "monitor", "counters")
+        assert "decision.adj_db_update" in out
+        out = self.breeze(ports[0], "version")
+        assert "20" in out
+        out = self.breeze(ports[0], "prefixmgr", "view")
+        out = self.breeze(ports[1], "prefixmgr", "view")
+        assert "fc01::/64" in out
+
+        # drain via CLI and observe the overload bit propagate
+        self.breeze(ports[0], "lm", "set-node-overload")
+        out = self.breeze(ports[0], "lm", "links")
+        assert "node overloaded: True" in out
