@@ -1,0 +1,23 @@
+"""Benchmark helpers: one JSON line per metric (SURVEY §6 harness)."""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable
+
+
+def measure_ms(fn: Callable[[], None], reps: int = 3, warmup: int = 1) -> float:
+    for _ in range(warmup):
+        fn()
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        times.append((time.perf_counter() - t0) * 1e3)
+    times.sort()
+    return times[len(times) // 2]
+
+
+def emit(metric: str, value: float, unit: str = "ms", **extra) -> None:
+    print(json.dumps({"metric": metric, "value": round(value, 3), "unit": unit, **extra}))

@@ -1,0 +1,135 @@
+"""Batched failure-protection kernels: SRLG what-if + TI-LFA backups.
+
+These are the NEW capabilities unlocked by the batch dimension
+(BASELINE.json configs #4/#5) — the reference computes nothing like them
+(its solver answers one source at a time; what-if analysis would need a
+full Decision re-run per scenario).
+
+- `srlg_what_if`: evaluate F failure scenarios (each an edge mask, e.g.
+  all members of a shared-risk link group) x S sources in ONE device
+  call: dist [F, S, N].  Operators use this for maintenance planning:
+  "which prefixes lose reachability / degrade if this conduit is cut?"
+
+- `ti_lfa_backups`: per-source per-out-edge post-convergence distances:
+  for each of a source's out-edges, distances with that edge (and its
+  reverse) failed — exactly the state TI-LFA needs to pick loop-free
+  backup next-hops and repair segments (P/Q analysis happens on these
+  distance tensors).
+
+Both reuse the fixed-point relaxation kernel (ops.sssp.batched_sssp);
+the batch rows are independent, so they shard collective-free over the
+"batch" mesh axis (openr_tpu.parallel).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .sssp import INF32, batched_sssp, make_dist0, make_relax_allowed, sp_dag_mask
+
+
+@jax.jit
+def srlg_what_if(
+    sources: jax.Array,  # [S] int32
+    edge_src: jax.Array,  # [E]
+    edge_dst: jax.Array,  # [E]
+    edge_metric: jax.Array,  # [E]
+    edge_up: jax.Array,  # [E] bool
+    node_overloaded: jax.Array,  # [N] bool
+    scenario_masks: jax.Array,  # [F, E] bool — True = edge SURVIVES
+) -> jax.Array:
+    """Distances under each failure scenario: [F, S, N] int32."""
+    n_nodes = node_overloaded.shape[0]
+    base_allowed = make_relax_allowed(
+        sources, edge_src, edge_up, node_overloaded
+    )  # [S, E]
+
+    def one_scenario(mask):
+        allowed = base_allowed & mask[None, :]
+        return batched_sssp(
+            make_dist0(sources, n_nodes), edge_src, edge_dst, edge_metric, allowed
+        )
+
+    return jax.lax.map(one_scenario, scenario_masks)
+
+
+@jax.jit
+def srlg_reachability_loss(
+    baseline_dist: jax.Array,  # [S, N]
+    scenario_dist: jax.Array,  # [F, S, N]
+) -> tuple[jax.Array, jax.Array]:
+    """Per scenario: (#newly-unreachable pairs, #degraded pairs)."""
+    was_reachable = baseline_dist < INF32
+    now_unreachable = was_reachable[None] & (scenario_dist >= INF32)
+    degraded = (
+        was_reachable[None]
+        & (scenario_dist < INF32)
+        & (scenario_dist > baseline_dist[None])
+    )
+    axes = (1, 2)
+    return now_unreachable.sum(axes), degraded.sum(axes)
+
+
+@functools.partial(jax.jit, static_argnames=("max_degree",))
+def ti_lfa_backups(
+    source: jax.Array,  # scalar int32 — protected source node
+    out_edge_ids: jax.Array,  # [D] int32 — source's out-edge ids (-1 pad)
+    edge_src: jax.Array,  # [E]
+    edge_dst: jax.Array,  # [E]
+    edge_metric: jax.Array,  # [E]
+    edge_up: jax.Array,  # [E] bool
+    node_overloaded: jax.Array,  # [N] bool
+    reverse_edge_ids: jax.Array,  # [E] int32 — id of each edge's reverse
+    max_degree: int,
+) -> tuple[jax.Array, jax.Array]:
+    """Post-convergence SPF per protected out-edge.
+
+    Returns (dist [D, N], dag [D, E]): row d = distances / SP-DAG with
+    out_edge_ids[d] (and its reverse) removed.  A backup next-hop for
+    destination v on failure of edge d is any first hop of row d's DAG;
+    TI-LFA P/Q spaces and repair-segment endpoints derive from these plus
+    per-neighbor distance rows (computed by the same kernel batched over
+    sources)."""
+    del max_degree  # shape already fixed by out_edge_ids
+    n_edges = edge_src.shape[0]
+    d_dim = out_edge_ids.shape[0]
+
+    edge_ids = jnp.arange(n_edges, dtype=jnp.int32)
+    fail = out_edge_ids  # [D]
+    fail_rev = jnp.where(
+        fail >= 0, reverse_edge_ids[jnp.maximum(fail, 0)], -1
+    )  # [D]
+    # per-row exclusion mask: True = edge survives
+    survives = (edge_ids[None, :] != fail[:, None]) & (
+        edge_ids[None, :] != fail_rev[:, None]
+    )  # [D, E]
+
+    sources = jnp.broadcast_to(source, (d_dim,)).astype(jnp.int32)
+    allowed = make_relax_allowed(
+        sources, edge_src, edge_up, node_overloaded, survives
+    )
+    n_nodes = node_overloaded.shape[0]
+    dist = batched_sssp(
+        make_dist0(sources, n_nodes), edge_src, edge_dst, edge_metric, allowed
+    )
+    dag = sp_dag_mask(dist, edge_src, edge_dst, edge_metric, allowed)
+    return dist, dag
+
+
+def build_reverse_edge_ids(edge_src, edge_dst) -> "jax.Array":
+    """Host helper: for each directed edge (u, v), the id of (v, u); -1 if
+    absent.  O(E) dict pass over numpy arrays."""
+    import numpy as np
+
+    src = np.asarray(edge_src)
+    dst = np.asarray(edge_dst)
+    index: dict[tuple[int, int], int] = {}
+    for e in range(len(src)):
+        index.setdefault((int(src[e]), int(dst[e])), e)
+    rev = np.full(len(src), -1, dtype=np.int32)
+    for e in range(len(src)):
+        rev[e] = index.get((int(dst[e]), int(src[e])), -1)
+    return jnp.asarray(rev)
