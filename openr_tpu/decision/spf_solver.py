@@ -18,6 +18,7 @@ mirroring the reference's plugin seam for drop-in solvers
 from __future__ import annotations
 
 import ipaddress
+import weakref
 from typing import Optional, Protocol
 
 from ..types import (
@@ -109,19 +110,23 @@ class DeviceSpfBackend:
     (openr/decision/LinkState.h:279-282) with one bulk device pass."""
 
     def __init__(self) -> None:
-        self._cache: dict[int, tuple[int, dict[str, SpfResult]]] = {}
+        # Keyed on the LinkState object itself (weakly) rather than id():
+        # ids are recycled after GC, so an id-keyed cache could serve another
+        # topology's results and leaks entries for dead LinkStates.
+        self._cache: "weakref.WeakKeyDictionary[LinkState, tuple[int, dict[str, SpfResult]]]" = (
+            weakref.WeakKeyDictionary()
+        )
 
     def get_spf_result(self, link_state: LinkState, src: str) -> SpfResult:
         from .csr import CsrTopology
 
-        key = id(link_state)
-        cached = self._cache.get(key)
+        cached = self._cache.get(link_state)
         if cached is None or cached[0] != link_state.version:
             csr = CsrTopology.from_link_state(link_state)
             sources = [n for n in link_state.node_names if link_state.links_from_node(n)]
             results = csr.spf_from(sources) if sources else {}
-            self._cache[key] = (link_state.version, results)
-            cached = self._cache[key]
+            cached = (link_state.version, results)
+            self._cache[link_state] = cached
         if src not in cached[1]:
             # isolated/unknown node: empty-but-self result via host path
             return link_state.get_spf_result(src)
@@ -375,7 +380,10 @@ class SpfSolver:
         filtered_node_areas = set(best.all_node_areas)
         if best.has_node(self.my_node_name) and per_destination:
             for node_area, entry in prefix_entries.items():
-                if node_area[0] == self.my_node_name and entry.prepend_label:
+                if (
+                    node_area[0] == self.my_node_name
+                    and entry.prepend_label is not None
+                ):
                     filtered_node_areas.discard(node_area)
                     break
 
@@ -468,7 +476,9 @@ class SpfSolver:
             entry = prefix_entries.get((next_node, area))
             if entry is None:
                 continue
-            if entry.prepend_label:
+            if entry.prepend_label is not None:
+                if not is_mpls_label_valid(entry.prepend_label):
+                    continue
                 labels.insert(0, entry.prepend_label)
 
             first_link = path[0]
@@ -518,13 +528,14 @@ class SpfSolver:
                 (
                     entry.prepend_label
                     for (node, _a), entry in prefix_entries.items()
-                    if node == self.my_node_name and entry.prepend_label
+                    if node == self.my_node_name
+                    and entry.prepend_label is not None
                 ),
                 None,
             )
-            assert prepend_label is not None  # guarded by caller
-            for nh in self.static_mpls_routes.get(prepend_label, ()):
-                nexthops.add(NextHop(address=nh.address, metric=0))
+            if prepend_label is not None:
+                for nh in self.static_mpls_routes.get(prepend_label, ()):
+                    nexthops.add(NextHop(address=nh.address, metric=0))
 
         return RibUnicastEntry(
             prefix=prefix,
@@ -639,7 +650,10 @@ class SpfSolver:
                     if dst_node:
                         push_labels: list[int] = []
                         dst_entry = prefix_entries.get((dst_node, area))
-                        if dst_entry is not None and dst_entry.prepend_label:
+                        if (
+                            dst_entry is not None
+                            and dst_entry.prepend_label is not None
+                        ):
                             push_labels.append(dst_entry.prepend_label)
                             if not is_mpls_label_valid(push_labels[-1]):
                                 continue

@@ -278,7 +278,9 @@ class Fib(OpenrEventBase):
                 ]
                 if to_add:
                     self.agent.add_unicast_routes(self.client_id, to_add)
-                to_del = list(update.unicast_routes_to_delete) + newly_uninstalled
+                to_del = list(update.unicast_routes_to_delete) + list(
+                    newly_uninstalled
+                )
                 if to_del:
                     self.agent.delete_unicast_routes(self.client_id, to_del)
                 if self.enable_segment_routing:

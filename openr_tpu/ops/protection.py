@@ -126,10 +126,20 @@ def build_reverse_edge_ids(edge_src, edge_dst) -> "jax.Array":
 
     src = np.asarray(edge_src)
     dst = np.asarray(edge_dst)
-    index: dict[tuple[int, int], int] = {}
+    # Parallel links between the same node pair must pair up one-to-one:
+    # the k-th (u, v) edge reverses to the k-th (v, u) edge, so a failed
+    # directed edge is paired with the reverse of *its own* link instance,
+    # not the first parallel link found.
+    index: dict[tuple[int, int], list[int]] = {}
+    occurrence = np.zeros(len(src), dtype=np.int64)
     for e in range(len(src)):
-        index.setdefault((int(src[e]), int(dst[e])), e)
+        bucket = index.setdefault((int(src[e]), int(dst[e])), [])
+        occurrence[e] = len(bucket)
+        bucket.append(e)
     rev = np.full(len(src), -1, dtype=np.int32)
     for e in range(len(src)):
-        rev[e] = index.get((int(dst[e]), int(src[e])), -1)
+        candidates = index.get((int(dst[e]), int(src[e])), [])
+        k = int(occurrence[e])
+        if k < len(candidates):
+            rev[e] = candidates[k]
     return jnp.asarray(rev)

@@ -261,13 +261,21 @@ class PrefixManager(OpenrEventBase):
         )
         return by_type[best_type]
 
-    def _sync_prefix(self, prefix: str, areas: Iterable[str]) -> None:
-        """(Re-)advertise or withdraw one prefix key per area."""
+    def _sync_prefix(
+        self,
+        prefix: str,
+        areas: Iterable[str],
+        skip_areas: frozenset[str] | set[str] = frozenset(),
+    ) -> None:
+        """(Re-)advertise or withdraw one prefix key per area.  Areas in
+        `skip_areas` are treated as withdrawals even when the entry exists —
+        used by redistribution so an area the route traversed earlier gets
+        its previously advertised key tombstoned, not silently left stale."""
         entry = self._best_entry(prefix)
         advertised = self._advertised.setdefault(prefix, set())
         for area in areas:
             key = prefix_key(self.node_name, prefix, area)
-            if entry is not None:
+            if entry is not None and area not in skip_areas:
                 db = PrefixDatabase(
                     this_node_name=self.node_name,
                     prefix_entries=[entry],
@@ -311,9 +319,14 @@ class PrefixManager(OpenrEventBase):
                     min_nexthop=best.min_nexthop,
                 )
                 changed = self._add_entry(PrefixType.RIB, redistributed)
-                other_areas = tuple(a for a in self.areas if a != src_area)
+                # Skip every area the entry already traversed, not just the
+                # immediate source area (reference: PrefixManager.cpp:239-247
+                # updateKvStorePrefixEntry areaStack.count(toArea) check) —
+                # otherwise 3+ area topologies can re-advertise a route back
+                # into an area it came through, looping cross-area routes.
+                seen_areas = set(redistributed.area_stack) | {src_area}
                 for p in changed:
-                    self._sync_prefix(p, other_areas)
+                    self._sync_prefix(p, self.areas, skip_areas=seen_areas)
             for prefix in update.unicast_routes_to_delete:
                 for p in self._del_entry(PrefixType.RIB, prefix):
                     self._sync_prefix(p, self.areas)

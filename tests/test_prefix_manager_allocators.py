@@ -165,6 +165,54 @@ class TestPrefixManager:
             pm.stop()
             pm.wait_until_stopped(5)
 
+    def test_redistribution_skips_traversed_areas(self):
+        """A route whose area_stack already contains an area must not be
+        re-advertised back into it (reference: PrefixManager.cpp:239-247
+        areaStack.count(toArea) check) — prevents 3-area advertisement
+        loops."""
+        fabric = InProcessTransport()
+        n = Node("node1", fabric, areas=("a", "b", "c"))
+        routeq: ReplicateQueue = ReplicateQueue()
+        pm = PrefixManager(
+            "node1",
+            n.client,
+            route_updates=routeq.get_reader(),
+            areas=("a", "b", "c"),
+        )
+        pm.run()
+        try:
+            pfx = "fd00::/64"
+            u = DecisionRouteUpdate()
+            u.add_route_to_update(
+                RibUnicastEntry(
+                    prefix=pfx,
+                    nexthops=frozenset({NextHop(address="fe80::1")}),
+                    best_prefix_entry=PrefixEntry(
+                        prefix=pfx, area_stack=("c",)
+                    ),
+                    best_area="a",
+                )
+            )
+            routeq.push(u)
+            key_b = prefix_key("node1", pfx, "b")
+            assert wait_for(
+                lambda: n.kvstore.get_key_vals("b", [key_b]).key_vals.get(
+                    key_b
+                )
+                is not None
+            )
+            # area "c" is already in the stack; area "a" is the source —
+            # neither may receive the redistributed route
+            for area in ("a", "c"):
+                key = prefix_key("node1", pfx, area)
+                raw = n.kvstore.get_key_vals(area, [key]).key_vals.get(key)
+                assert raw is None, f"route leaked back into area {area}"
+        finally:
+            routeq.close()
+            pm.stop()
+            pm.wait_until_stopped(5)
+            n.stop()
+
     def test_originated_prefix_aggregation(self, node):
         routeq: ReplicateQueue = ReplicateQueue()
         pm = PrefixManager(
