@@ -384,8 +384,10 @@ class SpfSolver:
                     node_area[0] == self.my_node_name
                     and entry.prepend_label is not None
                 ):
+                    # every self-advertised (node, area) must be excluded —
+                    # a multi-area self anycast advertisement would otherwise
+                    # keep one entry at SPF distance 0 and kill the route
                     filtered_node_areas.discard(node_area)
-                    break
 
         min_metric, nexthop_nodes = self._get_next_hops_with_metric(
             filtered_node_areas, per_destination, area_link_states

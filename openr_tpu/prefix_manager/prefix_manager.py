@@ -261,17 +261,19 @@ class PrefixManager(OpenrEventBase):
         )
         return by_type[best_type]
 
-    def _sync_prefix(
-        self,
-        prefix: str,
-        areas: Iterable[str],
-        skip_areas: frozenset[str] | set[str] = frozenset(),
-    ) -> None:
-        """(Re-)advertise or withdraw one prefix key per area.  Areas in
-        `skip_areas` are treated as withdrawals even when the entry exists —
-        used by redistribution so an area the route traversed earlier gets
-        its previously advertised key tombstoned, not silently left stale."""
+    def _sync_prefix(self, prefix: str, areas: Iterable[str]) -> None:
+        """(Re-)advertise or withdraw one prefix key per area.
+
+        Any area already present in the selected entry's own `area_stack`
+        is treated as a withdrawal even though the entry exists (reference:
+        PrefixManager.cpp:239-247 areaStack.count(toArea)): a redistributed
+        route must never be advertised back into an area it traversed, and
+        if the best-path shift added an area to the stack, the previously
+        advertised key there gets tombstoned rather than left stale.
+        Computed per-entry so a competing self-originated entry (empty
+        stack) winning best-entry selection is unaffected."""
         entry = self._best_entry(prefix)
+        skip_areas = set(entry.area_stack) if entry is not None else set()
         advertised = self._advertised.setdefault(prefix, set())
         for area in areas:
             key = prefix_key(self.node_name, prefix, area)
@@ -319,14 +321,10 @@ class PrefixManager(OpenrEventBase):
                     min_nexthop=best.min_nexthop,
                 )
                 changed = self._add_entry(PrefixType.RIB, redistributed)
-                # Skip every area the entry already traversed, not just the
-                # immediate source area (reference: PrefixManager.cpp:239-247
-                # updateKvStorePrefixEntry areaStack.count(toArea) check) —
-                # otherwise 3+ area topologies can re-advertise a route back
-                # into an area it came through, looping cross-area routes.
-                seen_areas = set(redistributed.area_stack) | {src_area}
+                # _sync_prefix skips every area in the entry's area_stack
+                # (which includes src_area, appended above)
                 for p in changed:
-                    self._sync_prefix(p, self.areas, skip_areas=seen_areas)
+                    self._sync_prefix(p, self.areas)
             for prefix in update.unicast_routes_to_delete:
                 for p in self._del_entry(PrefixType.RIB, prefix):
                     self._sync_prefix(p, self.areas)
