@@ -1,0 +1,104 @@
+// CPU Dijkstra baseline for the SPF benchmarks.
+//
+// Original implementation of the reference's per-source Dijkstra semantics
+// (openr/decision/LinkState.cpp:809-878 runSpf): binary-heap Dijkstra over a
+// CSR graph, positive integer metrics, down links never relax, overloaded
+// (drained) nodes are reachable but give no transit unless they are the
+// source.  One sequential run per source — exactly the work the reference
+// does when all sources are queried (getSpfResult per node) — giving the
+// honest CPU baseline the batched TPU kernel is compared against.
+//
+// Built as a shared library, driven via ctypes (benchmarks/cpp_baseline.py).
+
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <vector>
+
+namespace {
+
+constexpr int32_t kInf = 1 << 30;
+
+struct Csr {
+  std::vector<int32_t> offsets;  // [n_nodes + 1]
+  std::vector<int32_t> dst;      // [n_edges]
+  std::vector<int32_t> metric;   // [n_edges]
+};
+
+// Build an out-edge CSR from directed edge lists, dropping down edges.
+Csr build_csr(int n_nodes, int n_edges, const int32_t* edge_src,
+              const int32_t* edge_dst, const int32_t* edge_metric,
+              const uint8_t* edge_up) {
+  Csr csr;
+  csr.offsets.assign(n_nodes + 1, 0);
+  int kept = 0;
+  for (int e = 0; e < n_edges; ++e) {
+    if (edge_up && !edge_up[e]) continue;
+    ++csr.offsets[edge_src[e] + 1];
+    ++kept;
+  }
+  for (int v = 0; v < n_nodes; ++v) csr.offsets[v + 1] += csr.offsets[v];
+  csr.dst.resize(kept);
+  csr.metric.resize(kept);
+  std::vector<int32_t> cursor(csr.offsets.begin(), csr.offsets.end() - 1);
+  for (int e = 0; e < n_edges; ++e) {
+    if (edge_up && !edge_up[e]) continue;
+    int pos = cursor[edge_src[e]]++;
+    csr.dst[pos] = edge_dst[e];
+    csr.metric[pos] = edge_metric[e];
+  }
+  return csr;
+}
+
+void dijkstra(const Csr& csr, int n_nodes, const uint8_t* node_overloaded,
+              int32_t source, int32_t* dist) {
+  std::fill(dist, dist + n_nodes, kInf);
+  dist[source] = 0;
+  using Item = std::pair<int32_t, int32_t>;  // (dist, node)
+  std::priority_queue<Item, std::vector<Item>, std::greater<Item>> heap;
+  heap.emplace(0, source);
+  while (!heap.empty()) {
+    auto [d, u] = heap.top();
+    heap.pop();
+    if (d > dist[u]) continue;  // stale entry
+    // drained nodes offer no transit unless they are the source
+    // (LinkState.cpp:829-836)
+    if (u != source && node_overloaded && node_overloaded[u]) continue;
+    for (int i = csr.offsets[u]; i < csr.offsets[u + 1]; ++i) {
+      int v = csr.dst[i];
+      int32_t nd = d + csr.metric[i];
+      if (nd < dist[v]) {
+        dist[v] = nd;
+        heap.emplace(nd, v);
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Runs Dijkstra from each source sequentially.  Returns seconds spent in
+// the SPF loop (graph build excluded).  If out_dist is non-null it receives
+// n_sources * n_nodes int32 distances (kInf = unreachable).
+double spf_all_sources(int n_nodes, int n_edges, const int32_t* edge_src,
+                       const int32_t* edge_dst, const int32_t* edge_metric,
+                       const uint8_t* edge_up, const uint8_t* node_overloaded,
+                       const int32_t* sources, int n_sources,
+                       int32_t* out_dist) {
+  Csr csr = build_csr(n_nodes, n_edges, edge_src, edge_dst, edge_metric,
+                      edge_up);
+  std::vector<int32_t> scratch;
+  if (!out_dist) scratch.resize(n_nodes);
+  auto t0 = std::chrono::steady_clock::now();
+  for (int s = 0; s < n_sources; ++s) {
+    int32_t* row = out_dist ? out_dist + static_cast<int64_t>(s) * n_nodes
+                            : scratch.data();
+    dijkstra(csr, n_nodes, node_overloaded, sources[s], row);
+  }
+  auto t1 = std::chrono::steady_clock::now();
+  return std::chrono::duration<double>(t1 - t0).count();
+}
+}

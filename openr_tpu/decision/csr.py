@@ -51,6 +51,9 @@ class CsrTopology:
     edge_links: list[tuple[Link, str]]
     n_edges: int = 0
     version: int = -1  # LinkState.version this mirror was built from
+    # degree-bucketed ELL mirror (ops.sssp.EllGraph) — the production
+    # relaxation tables; rebuilt with the edge arrays
+    ell: object = None
 
     # -- construction -------------------------------------------------------
 
@@ -106,6 +109,12 @@ class CsrTopology:
         for name, i in node_id.items():
             node_overloaded[i] = ls.is_node_overloaded(name)
 
+        from ..ops.sssp import build_ell
+
+        ell = build_ell(
+            edge_src, edge_dst, edge_metric, edge_up, node_overloaded, e
+        )
+
         return cls(
             node_names=names,
             node_id=node_id,
@@ -120,6 +129,7 @@ class CsrTopology:
             edge_links=[(r[4], r[5]) for r in rows],
             n_edges=e,
             version=ls.version,
+            ell=ell,
         )
 
     # -- SPF execution ------------------------------------------------------
@@ -130,35 +140,36 @@ class CsrTopology:
         use_link_metric: bool = True,
         extra_edge_mask: Optional[np.ndarray] = None,
     ) -> tuple[np.ndarray, np.ndarray]:
-        """Run the device kernel; returns (dist [S, N_cap], dag [S, E_cap])
-        as numpy."""
-        import jax.numpy as jnp
-
+        """Run the device kernel (bucketed-ELL relaxation); returns
+        (dist [S, N_cap], dag [S, E_cap]) as numpy."""
         from ..ops import sssp as ops
 
-        src_ids = jnp.asarray(
-            [self.node_id[s] for s in sources], dtype=jnp.int32
+        src_ids = np.asarray(
+            [self.node_id[s] for s in sources], dtype=np.int32
         )
-        e_src = jnp.asarray(self.edge_src)
-        e_dst = jnp.asarray(self.edge_dst)
-        metric = (
-            jnp.asarray(self.edge_metric)
-            if use_link_metric
-            else jnp.ones(self.edge_capacity, dtype=jnp.int32)
-        )
-        e_up = jnp.asarray(self.edge_up)
-        overloaded = jnp.asarray(self.node_overloaded)
-        allowed = ops.make_relax_allowed(
-            src_ids,
-            e_src,
-            e_up,
-            overloaded,
-            None if extra_edge_mask is None else jnp.asarray(extra_edge_mask),
-        )
-        dist = ops.batched_sssp(
-            ops.make_dist0(src_ids, self.node_capacity), e_src, e_dst, metric, allowed
-        )
-        dag = ops.sp_dag_mask(dist, e_src, e_dst, metric, allowed)
+        if extra_edge_mask is None:
+            dist, dag = ops.spf_forward_ell(
+                src_ids,
+                self.ell,
+                self.edge_src,
+                self.edge_dst,
+                self.edge_metric,
+                self.edge_up,
+                self.node_overloaded,
+                use_link_metric=use_link_metric,
+            )
+        else:
+            dist, dag = ops.spf_forward_ell_masked(
+                src_ids,
+                self.ell,
+                self.edge_src,
+                self.edge_dst,
+                self.edge_metric,
+                self.edge_up,
+                self.node_overloaded,
+                np.asarray(extra_edge_mask),
+                use_link_metric=use_link_metric,
+            )
         return np.asarray(dist), np.asarray(dag)
 
     # -- result reconstruction (parity with the host oracle) ----------------

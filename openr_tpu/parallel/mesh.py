@@ -48,25 +48,38 @@ def make_mesh(devices=None, batch_axis: int | None = None) -> Mesh:
 def spf_step_sharded(mesh: Mesh):
     """Return a jitted full SPF step (distances + SP-DAG) with explicit
     in/out shardings over `mesh`.  This is the multi-chip "training step"
-    equivalent: one call does the whole device-side route-compute pass."""
+    equivalent: one call does the whole device-side route-compute pass.
+
+    The relaxation runs on the bucketed-ELL tables (ops.batched_sssp_ell);
+    the transposed [N, S] distance state is sharded P("node", "batch"), so
+    the per-slot row gather all-gathers the node axis over ICI while the
+    source batch stays fully parallel."""
     s_batch = NamedSharding(mesh, P("batch"))
     s_dist = NamedSharding(mesh, P("batch", "node"))
+    s_dist_t = NamedSharding(mesh, P("node", "batch"))
     s_repl = NamedSharding(mesh, P())
 
-    def step(sources, edge_src, edge_dst, edge_metric, edge_up, node_overloaded):
-        n_nodes = node_overloaded.shape[0]
-        allowed = ops.make_relax_allowed(sources, edge_src, edge_up, node_overloaded)
-        dist0 = jax.lax.with_sharding_constraint(
-            ops.make_dist0(sources, n_nodes), s_dist
+    def step(sources, ell, edge_src, edge_dst, edge_metric, edge_up, node_overloaded):
+        n_cap = node_overloaded.shape[0]
+        dist0_t = jax.lax.with_sharding_constraint(
+            ops.make_dist0_T(sources, ell.new_of_old, n_cap), s_dist_t
         )
-        dist = ops.batched_sssp(dist0, edge_src, edge_dst, edge_metric, allowed)
-        dist = jax.lax.with_sharding_constraint(dist, s_dist)
-        dag = ops.sp_dag_mask(dist, edge_src, edge_dst, edge_metric, allowed)
+        dist_t = ops.batched_sssp_ell(
+            dist0_t, ell, edge_up=edge_up, node_overloaded=node_overloaded
+        )
+        dist_old_t = ops.ell_dist_to_old_T(dist_t, ell)
+        allowed_t = ops.make_relax_allowed_T(
+            sources, edge_src, edge_up, node_overloaded
+        )
+        dag = ops.sp_dag_mask_from_T(
+            dist_old_t, edge_src, edge_dst, edge_metric, allowed_t
+        )
+        dist = jax.lax.with_sharding_constraint(dist_old_t.T, s_dist)
         return dist, dag
 
     return jax.jit(
         step,
-        in_shardings=(s_batch, s_repl, s_repl, s_repl, s_repl, s_repl),
+        in_shardings=(s_batch, s_repl, s_repl, s_repl, s_repl, s_repl, s_repl),
         out_shardings=(s_dist, s_batch),
     )
 
@@ -74,6 +87,7 @@ def spf_step_sharded(mesh: Mesh):
 def sharded_spf_forward(
     mesh: Mesh,
     sources: jax.Array,
+    ell,
     edge_src: jax.Array,
     edge_dst: jax.Array,
     edge_metric: jax.Array,
@@ -82,4 +96,6 @@ def sharded_spf_forward(
 ) -> tuple[jax.Array, jax.Array]:
     """One-shot convenience wrapper around `spf_step_sharded`."""
     step = spf_step_sharded(mesh)
-    return step(sources, edge_src, edge_dst, edge_metric, edge_up, node_overloaded)
+    return step(
+        sources, ell, edge_src, edge_dst, edge_metric, edge_up, node_overloaded
+    )

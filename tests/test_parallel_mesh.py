@@ -56,6 +56,7 @@ class TestMeshSpf:
         dist_sharded, dag_sharded = sharded_spf_forward(
             mesh,
             sources,
+            csr.ell,
             csr.edge_src,
             csr.edge_dst,
             csr.edge_metric,
@@ -92,6 +93,7 @@ class TestMeshSpf:
         s_repl = NamedSharding(mesh, P())
         dist, dag = step(
             jax.device_put(sources, s_batch),
+            jax.device_put(csr.ell, s_repl),
             jax.device_put(np.asarray(csr.edge_src), s_repl),
             jax.device_put(np.asarray(csr.edge_dst), s_repl),
             jax.device_put(np.asarray(csr.edge_metric), s_repl),
@@ -120,6 +122,7 @@ class TestMeshSpf:
         dist, _ = sharded_spf_forward(
             mesh,
             sources,
+            csr.ell,
             csr.edge_src,
             csr.edge_dst,
             csr.edge_metric,

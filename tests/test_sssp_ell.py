@@ -1,0 +1,274 @@
+"""Unit tests for the bucketed-ELL SSSP kernel internals (ops/sssp.py):
+bucket construction invariants, multi-bucket skewed-degree graphs, masked
+(per-row exclusion) runs, and parity with the edge-list kernel.
+
+The oracle-parity of the full pipeline is covered by
+test_sssp_conformance.py (CsrTopology.spf_from routes through ELL)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from openr_tpu.decision import LinkState
+from openr_tpu.decision.csr import CsrTopology
+from openr_tpu.ops import sssp as ops
+from openr_tpu.utils.topo import fat_tree_topology, random_topology
+
+from test_link_state import adj, adj_db, build
+
+
+def star_plus_chain(n_leaves: int, chain: int):
+    """Hub with n_leaves spokes + a chain hanging off leaf 0: produces a
+    strongly skewed in-degree distribution (hub deg n_leaves, rest <= 2)."""
+    dbs = [adj_db("hub", [adj("hub", f"leaf{i}") for i in range(n_leaves)])]
+    for i in range(n_leaves):
+        adjs = [adj(f"leaf{i}", "hub")]
+        if i == 0 and chain:
+            adjs.append(adj("leaf0", "c0"))
+        dbs.append(adj_db(f"leaf{i}", adjs))
+    for j in range(chain):
+        adjs = [adj(f"c{j}", f"c{j-1}" if j else "leaf0")]
+        if j + 1 < chain:
+            adjs.append(adj(f"c{j}", f"c{j+1}"))
+        dbs.append(adj_db(f"c{j}", adjs))
+    return dbs
+
+
+class TestBuildEll:
+    def test_buckets_cover_capacity_in_order(self):
+        ls = build(star_plus_chain(40, 10))
+        csr = CsrTopology.from_link_state(ls)
+        ell = csr.ell
+        rows = sum(b.nbr.shape[0] for b in ell.buckets)
+        assert rows == csr.node_capacity
+        # descending K, at least 2 buckets for this skew
+        ks = [b.nbr.shape[1] for b in ell.buckets]
+        assert ks == sorted(ks, reverse=True)
+        assert len(ks) >= 2
+        # K is a power of two >= the max in-degree in the bucket
+        deg = np.bincount(
+            csr.edge_dst[: csr.n_edges], minlength=csr.node_capacity
+        )
+        lo = 0
+        for b in ell.buckets:
+            r, k = b.nbr.shape
+            bucket_deg = deg[ell.old_of_new[lo : lo + r]]
+            assert bucket_deg.max(initial=0) <= k
+            assert (k & (k - 1)) == 0
+            lo += r
+
+    def test_permutation_is_bijective(self):
+        ls = build(random_topology(30, 40, seed=3))
+        csr = CsrTopology.from_link_state(ls)
+        ell = csr.ell
+        assert sorted(ell.old_of_new.tolist()) == list(range(csr.node_capacity))
+        np.testing.assert_array_equal(
+            ell.new_of_old[ell.old_of_new], np.arange(csr.node_capacity)
+        )
+
+    def test_slots_match_edges(self):
+        ls = build(star_plus_chain(12, 4))
+        csr = CsrTopology.from_link_state(ls)
+        ell = csr.ell
+        seen_edges = set()
+        lo = 0
+        for b in ell.buckets:
+            r, k = b.nbr.shape
+            for i in range(r):
+                v_old = int(ell.old_of_new[lo + i])
+                for j in range(k):
+                    e = int(b.edge_id[i, j])
+                    if e < 0:
+                        assert not b.ok[i, j]
+                        continue
+                    seen_edges.add(e)
+                    assert int(csr.edge_dst[e]) == v_old
+                    assert int(ell.new_of_old[csr.edge_src[e]]) == int(
+                        b.nbr[i, j]
+                    )
+                    assert int(csr.edge_metric[e]) == int(b.w[i, j])
+                    assert bool(csr.edge_up[e]) == bool(b.ok[i, j])
+            lo += r
+        assert seen_edges == set(range(csr.n_edges))
+
+
+class TestEllKernelParity:
+    """ELL kernel vs the edge-list kernel on identical inputs."""
+
+    def _both(self, csr, sources, extra_mask=None):
+        import jax.numpy as jnp
+
+        src_ids = np.asarray(
+            [csr.node_id[s] for s in sources], dtype=np.int32
+        )
+        if extra_mask is None:
+            dist_ell, dag_ell = ops.spf_forward_ell(
+                src_ids,
+                csr.ell,
+                csr.edge_src,
+                csr.edge_dst,
+                csr.edge_metric,
+                csr.edge_up,
+                csr.node_overloaded,
+            )
+        else:
+            dist_ell, dag_ell = ops.spf_forward_ell_masked(
+                src_ids,
+                csr.ell,
+                csr.edge_src,
+                csr.edge_dst,
+                csr.edge_metric,
+                csr.edge_up,
+                csr.node_overloaded,
+                extra_mask,
+            )
+        allowed = ops.make_relax_allowed(
+            jnp.asarray(src_ids),
+            jnp.asarray(csr.edge_src),
+            jnp.asarray(csr.edge_up),
+            jnp.asarray(csr.node_overloaded),
+            None if extra_mask is None else jnp.asarray(extra_mask),
+        )
+        dist_edge = ops.batched_sssp(
+            ops.make_dist0(jnp.asarray(src_ids), csr.node_capacity),
+            jnp.asarray(csr.edge_src),
+            jnp.asarray(csr.edge_dst),
+            jnp.asarray(csr.edge_metric),
+            allowed,
+        )
+        dag_edge = ops.sp_dag_mask(
+            dist_edge,
+            jnp.asarray(csr.edge_src),
+            jnp.asarray(csr.edge_dst),
+            jnp.asarray(csr.edge_metric),
+            allowed,
+        )
+        return (
+            np.asarray(dist_ell),
+            np.asarray(dag_ell),
+            np.asarray(dist_edge),
+            np.asarray(dag_edge),
+        )
+
+    @pytest.mark.parametrize(
+        "dbs",
+        [
+            star_plus_chain(40, 10),
+            fat_tree_topology(4),
+            random_topology(40, 80, seed=7),
+        ],
+        ids=["star-chain", "fat-tree", "random"],
+    )
+    def test_dist_and_dag_match(self, dbs):
+        ls = build(dbs)
+        csr = CsrTopology.from_link_state(ls)
+        sources = ls.node_names
+        d1, g1, d2, g2 = self._both(csr, sources)
+        np.testing.assert_array_equal(d1[:, : csr.n_nodes], d2[:, : csr.n_nodes])
+        np.testing.assert_array_equal(g1[:, : csr.n_edges], g2[:, : csr.n_edges])
+
+    def test_overloaded_hub(self):
+        """Drained hub: still reachable, no transit — the d_u == 0 source
+        exception must let the hub itself still originate."""
+        dbs = star_plus_chain(8, 0)
+        ls = build(dbs)
+        hub_db = next(d for d in dbs if d.this_node_name == "hub")
+        hub_db.is_overloaded = True
+        ls.update_adjacency_database(hub_db)
+        csr = CsrTopology.from_link_state(ls)
+        sources = ls.node_names
+        d1, g1, d2, g2 = self._both(csr, sources)
+        np.testing.assert_array_equal(d1[:, : csr.n_nodes], d2[:, : csr.n_nodes])
+        # leaf -> leaf must be unreachable (only path transits drained hub)
+        r = sources.index("leaf1")
+        c = csr.node_id["leaf2"]
+        assert d1[r, c] == int(ops.INF32)
+        # hub itself still reaches all leaves
+        r = sources.index("hub")
+        assert d1[r, csr.node_id["leaf2"]] == 1
+
+    def test_masked_rows(self):
+        """Per-row edge exclusions (the KSP/what-if capability)."""
+        ls = build(random_topology(20, 26, seed=11))
+        csr = CsrTopology.from_link_state(ls)
+        sources = ls.node_names[:8]
+        rng = np.random.RandomState(5)
+        mask = np.ones((len(sources), csr.edge_capacity), dtype=bool)
+        for row in range(len(sources)):
+            kill = rng.choice(csr.n_edges, size=3, replace=False)
+            mask[row, kill] = False
+        d1, g1, d2, g2 = self._both(csr, sources, extra_mask=mask)
+        np.testing.assert_array_equal(d1[:, : csr.n_nodes], d2[:, : csr.n_nodes])
+        np.testing.assert_array_equal(g1[:, : csr.n_edges], g2[:, : csr.n_edges])
+
+    def test_runtime_edge_state_overrides_build_snapshot(self):
+        """edge_up / node_overloaded passed at call time must win over the
+        snapshots baked into the ELL tables — a link flap after build may
+        not route through the dead link."""
+        ls = build(
+            [
+                adj_db("a", [adj("a", "b"), adj("a", "c", metric=10)]),
+                adj_db("b", [adj("b", "a"), adj("b", "c")]),
+                adj_db("c", [adj("c", "b"), adj("c", "a", metric=10)]),
+            ]
+        )
+        csr = CsrTopology.from_link_state(ls)
+        src = np.asarray([csr.node_id["a"]], dtype=np.int32)
+        dist, _ = ops.spf_forward_ell(
+            src,
+            csr.ell,
+            csr.edge_src,
+            csr.edge_dst,
+            csr.edge_metric,
+            csr.edge_up,
+            csr.node_overloaded,
+        )
+        assert np.asarray(dist)[0, csr.node_id["c"]] == 2  # a-b-c
+
+        # kill the a<->b link in the runtime arrays only (ELL not rebuilt)
+        up = csr.edge_up.copy()
+        for e in range(csr.n_edges):
+            uv = {int(csr.edge_src[e]), int(csr.edge_dst[e])}
+            if uv == {csr.node_id["a"], csr.node_id["b"]}:
+                up[e] = False
+        dist2, _ = ops.spf_forward_ell(
+            src,
+            csr.ell,
+            csr.edge_src,
+            csr.edge_dst,
+            csr.edge_metric,
+            up,
+            csr.node_overloaded,
+        )
+        assert np.asarray(dist2)[0, csr.node_id["c"]] == 10  # direct a-c
+
+        # drain b in the runtime arrays only: a-b-c transit must die too
+        over = csr.node_overloaded.copy()
+        over[csr.node_id["b"]] = True
+        dist3, _ = ops.spf_forward_ell(
+            src,
+            csr.ell,
+            csr.edge_src,
+            csr.edge_dst,
+            csr.edge_metric,
+            csr.edge_up,
+            over,
+        )
+        assert np.asarray(dist3)[0, csr.node_id["c"]] == 10
+        assert np.asarray(dist3)[0, csr.node_id["b"]] == 1  # still reachable
+
+    def test_check_every_batching(self):
+        """check_every > 1 must not change the fixed point."""
+        import jax.numpy as jnp
+
+        ls = build(random_topology(25, 30, seed=2))
+        csr = CsrTopology.from_link_state(ls)
+        src_ids = jnp.arange(csr.n_nodes, dtype=jnp.int32)
+        d0 = ops.make_dist0_T(
+            src_ids, jnp.asarray(csr.ell.new_of_old), csr.node_capacity
+        )
+        ref = np.asarray(ops.batched_sssp_ell(d0, csr.ell))
+        for ce in (2, 5, 16):
+            got = np.asarray(ops.batched_sssp_ell(d0, csr.ell, check_every=ce))
+            np.testing.assert_array_equal(ref, got)
