@@ -32,6 +32,31 @@ def _next_pow2(n: int, floor: int = 8) -> int:
     return c
 
 
+def _build_out_slots(
+    edge_src: np.ndarray, edge_dst: np.ndarray, n_edges: int
+) -> tuple[np.ndarray, int]:
+    """out_slot[e] = rank of edge e's dst among src(e)'s sorted unique
+    out-neighbors (parallel links share the slot); -1 for padding.
+    Node ids are assigned in sorted-name order, so id rank == the
+    reference's name-sorted neighbor ordering.  Vectorized numpy."""
+    e_cap = len(edge_src)
+    out_slot = np.full(e_cap, -1, dtype=np.int32)
+    if n_edges == 0:
+        return out_slot, 0
+    src = edge_src[:n_edges].astype(np.int64)
+    dst = edge_dst[:n_edges].astype(np.int64)
+    order = np.lexsort((dst, src))
+    s_o, d_o = src[order], dst[order]
+    new_grp = np.r_[True, s_o[1:] != s_o[:-1]]
+    new_nbr = new_grp | np.r_[False, d_o[1:] != d_o[:-1]]
+    nbr_rank = np.cumsum(new_nbr) - 1  # global distinct-neighbor counter
+    grp_id = np.cumsum(new_grp) - 1
+    first_rank = nbr_rank[new_grp]  # [n_groups]
+    slots = (nbr_rank - first_rank[grp_id]).astype(np.int32)
+    out_slot[order] = slots
+    return out_slot, int(slots.max()) + 1
+
+
 @dataclass
 class CsrTopology:
     """Padded directed-edge arrays + host-side interning tables."""
@@ -54,6 +79,14 @@ class CsrTopology:
     # degree-bucketed ELL mirror (ops.sssp.EllGraph) — the production
     # relaxation tables; rebuilt with the edge arrays
     ell: object = None
+    # out_slot[e]: index of edge e's destination among its source node's
+    # sorted unique out-neighbors (-1 padding) — feeds the bit-packed
+    # device first-hop kernel (ops.sssp.first_hops_ell)
+    out_slot: Optional[np.ndarray] = None
+    max_out_slots: int = 0  # max distinct out-neighbors over all nodes
+    # adaptive fixed-sweep hint for the relax loops (see spf_from); grows
+    # by doubling when a run fails to reach the fixed point
+    _sweep_hint: int = 16
 
     # -- construction -------------------------------------------------------
 
@@ -114,6 +147,7 @@ class CsrTopology:
         ell = build_ell(
             edge_src, edge_dst, edge_metric, edge_up, node_overloaded, e
         )
+        out_slot, max_out_slots = _build_out_slots(edge_src, edge_dst, e)
 
         return cls(
             node_names=names,
@@ -130,7 +164,64 @@ class CsrTopology:
             n_edges=e,
             version=ls.version,
             ell=ell,
+            out_slot=out_slot,
+            max_out_slots=max_out_slots,
         )
+
+    def refresh(self, ls: LinkState) -> bool:
+        """Bring the mirror to `ls.version`, in place when possible.
+
+        Returns True when only link/node ATTRIBUTES changed (metric, up,
+        overload): the edge arrays are updated in place and neither the
+        ELL tables nor compiled kernels are touched — the relaxation reads
+        edge_up / node_overloaded at call time (SURVEY §7 stage 2's
+        incremental device update).  On edge-set or node-set changes the
+        mirror is rebuilt; capacities are re-used when the new topology
+        still fits, so kernel shapes — and therefore XLA compilations —
+        are stable until a capacity bucket overflows."""
+        if ls.version == self.version:
+            return True
+        names = ls.node_names
+        same_topology = names == self.node_names and len(
+            ls.all_links
+        ) * 2 == self.n_edges
+        if same_topology:
+            # identical link OBJECTS?  Identity, not set equality:
+            # Link.__eq__ keys on (node, iface) pairs only, so a link that
+            # was removed and re-added as a new object would compare equal
+            # while our edge_links still points at the retired object
+            # (whose metric/up state no longer updates).
+            current = {id(link) for link, _ in self.edge_links}
+            same_topology = current == {id(link) for link in ls.all_links}
+        if not same_topology:
+            hint = self._sweep_hint
+            rebuilt = CsrTopology.from_link_state(
+                ls,
+                node_capacity=(
+                    self.node_capacity
+                    if len(names) < self.node_capacity
+                    else None
+                ),
+                edge_capacity=(
+                    self.edge_capacity
+                    if len(ls.all_links) * 2 <= self.edge_capacity
+                    else None
+                ),
+            )
+            self.__dict__.update(rebuilt.__dict__)
+            # the relax depth is a property of the topology shape; keep
+            # the learned hint across rebuilds
+            self._sweep_hint = hint
+            return False
+
+        # attribute-only refresh: links are shared objects, re-read values
+        for e, (link, from_name) in enumerate(self.edge_links):
+            self.edge_metric[e] = link.metric_from_node(from_name)
+            self.edge_up[e] = link.is_up()
+        for name, i in self.node_id.items():
+            self.node_overloaded[i] = ls.is_node_overloaded(name)
+        self.version = ls.version
+        return True
 
     # -- SPF execution ------------------------------------------------------
 
@@ -174,20 +265,40 @@ class CsrTopology:
 
     # -- result reconstruction (parity with the host oracle) ----------------
 
+    def slot_neighbors(self, node: str) -> list[str]:
+        """Sorted unique out-neighbor names of `node` — slot order of the
+        bit-packed device first-hop masks (ids are assigned in sorted-name
+        order, so id rank == name rank)."""
+        return self._slot_neighbors(self._links_of, node)
+
+    @staticmethod
+    def _slot_neighbors(
+        links_of: dict[str, list[Link]], node: str
+    ) -> list[str]:
+        return sorted(
+            {link.other_node_name(node) for link in links_of.get(node, ())}
+        )
+
     def to_spf_results(
         self,
         sources: list[str],
         dist: np.ndarray,
         dag: np.ndarray,
+        nh_words: Optional[np.ndarray] = None,  # [S, N_cap, W] uint32
     ) -> dict[str, SpfResult]:
         """Convert kernel output into reference-shaped SpfResults: per node
-        metric, tie-retaining path_links, and first-hop `next_hops` sets
-        (computed by host propagation along the SP-DAG in topological
-        order)."""
+        metric, tie-retaining path_links, and first-hop `next_hops` sets.
+
+        With `nh_words` (ops.sssp.first_hops_ell output) the next-hop sets
+        are decoded from the device bitmasks — O(reachable x set bits)
+        host work.  Without it, falls back to host DAG propagation
+        (O(S x N) — the round-1 bottleneck; kept for dist/dag-only
+        callers)."""
         from ..ops.sssp import INF32
 
         inf = int(INF32)
         out: dict[str, SpfResult] = {}
+        links_of = self._links_of  # hoisted: the property walks edge_links
         for row, src_name in enumerate(sources):
             d = dist[row]
             mask = dag[row]
@@ -202,62 +313,127 @@ class CsrTopology:
                 link, from_name = self.edge_links[e]
                 v = self.node_names[int(self.edge_dst[e])]
                 result[v].path_links.append((link, from_name))
-            # First hops: propagate along the DAG in increasing-distance
-            # order (metrics are >= 1 so this is a topological order).  A
-            # direct shortest edge src->v always contributes v itself as a
-            # first hop (reference: addNextHop(otherNodeName) fires while
-            # v's set is still empty at src's pop, and survives unless a
-            # strictly shorter path resets it — i.e. iff src->v is a DAG
-            # edge).
             src_id = self.node_id[src_name]
-            order = sorted(reachable, key=lambda i: (int(d[i]), self.node_names[i]))
-            for i in order:
-                if i == src_id:
-                    continue
-                name = self.node_names[i]
-                res = result[name]
-                for link, prev in res.path_links:
-                    if prev == src_name:
-                        res.next_hops.add(name)
-                    else:
-                        res.next_hops |= result[prev].next_hops
+            if nh_words is not None:
+                slot_names = self._slot_neighbors(links_of, src_name)
+                words = nh_words[row]
+                for i in reachable:
+                    if i == src_id:
+                        continue
+                    hops = result[self.node_names[i]].next_hops
+                    for w in range(words.shape[1]):
+                        bits = int(words[i, w])
+                        base = 32 * w
+                        while bits:
+                            b = bits & -bits
+                            hops.add(slot_names[base + b.bit_length() - 1])
+                            bits ^= b
+            else:
+                # First hops by host propagation along the DAG in
+                # increasing-distance order (metrics >= 1 makes this a
+                # topological order).  A direct shortest edge src->v
+                # contributes v itself (reference: addNextHop fires while
+                # v's set is empty at src's pop and survives unless a
+                # strictly shorter path resets it — i.e. iff src->v is a
+                # DAG edge).
+                order = sorted(
+                    reachable, key=lambda i: (int(d[i]), self.node_names[i])
+                )
+                for i in order:
+                    if i == src_id:
+                        continue
+                    name = self.node_names[i]
+                    res = result[name]
+                    for link, prev in res.path_links:
+                        if prev == src_name:
+                            res.next_hops.add(name)
+                        else:
+                            res.next_hops |= result[prev].next_hops
             out[src_name] = result
         return out
 
     def spf_from(
         self, sources: list[str], use_link_metric: bool = True
     ) -> dict[str, SpfResult]:
-        dist, dag = self.run_batched_spf(sources, use_link_metric)
-        return self.to_spf_results(sources, dist, dag)
+        """Full production pipeline: one device call (distances + SP-DAG +
+        bit-packed first hops) -> reference-shaped SpfResults."""
+        from ..ops import sssp as ops
 
-    # -- device first-hop support -------------------------------------------
-
-    def build_edge_slots(
-        self, sources: list[str]
-    ) -> tuple[np.ndarray, list[list[str]]]:
-        """Per source row: map each out-edge of the row's source to a dense
-        'first hop slot' (index into that row's sorted unique neighbor
-        list).  Feeds ops.sssp.first_hop_matrix; slot lists translate device
-        output back to neighbor node names."""
-        slot_names: list[list[str]] = []
-        edge_slot = np.full(
-            (len(sources), self.edge_capacity), -1, dtype=np.int32
+        src_ids = np.asarray(
+            [self.node_id[s] for s in sources], dtype=np.int32
         )
-        links_of = self._links_of
-        edges_by_src: dict[int, list[int]] = {}
-        for e in range(self.n_edges):
-            edges_by_src.setdefault(int(self.edge_src[e]), []).append(e)
-        for row, src in enumerate(sources):
-            src_id = self.node_id[src]
-            neighbors = sorted(
-                {link.other_node_name(src) for link in links_of.get(src, ())}
+        n_words = max(1, -(-self._max_slots_of(sources) // 32))
+        s = len(sources)
+        args = (
+            src_ids,
+            self.ell,
+            self.edge_src,
+            self.edge_dst,
+            self.edge_metric,
+            self.edge_up,
+            self.node_overloaded,
+            self.out_slot,
+            n_words,
+        )
+        # Fixed-sweep execution with an adaptive per-topology hint: a
+        # data-dependent while_loop syncs host<->device per iteration on
+        # latency-bound transports, so we run `sweep_hint` sweeps (fori) +
+        # an in-program convergence verdict and double until it reads 1.
+        # The hint tracks the topology's relax depth (weighted-path hop
+        # count), which is stable across flaps.
+        small = s * self.node_capacity <= (1 << 21)
+        while True:
+            n_sweeps = self._sweep_hint
+            if small:
+                # small control-plane query: ONE packed transfer
+                packed = np.asarray(
+                    ops.spf_forward_full_packed(
+                        *args,
+                        use_link_metric=use_link_metric,
+                        n_sweeps=n_sweeps,
+                    )
+                )
+                converged = packed[-1] == 1
+            else:
+                # bulk batch: int32-widening the dag for packing would
+                # dominate memory; take separate fetches instead
+                dist_j, dag_j, nh_j, ok_j = ops.spf_forward_full(
+                    *args,
+                    use_link_metric=use_link_metric,
+                    n_sweeps=n_sweeps,
+                )
+                converged = bool(ok_j)
+            if converged:
+                break
+            self._sweep_hint = n_sweeps * 2
+        if small:
+            n_dist = s * self.node_capacity
+            n_dag = s * self.edge_capacity
+            dist = packed[:n_dist].reshape(s, self.node_capacity)
+            dag = packed[n_dist : n_dist + n_dag].reshape(
+                s, self.edge_capacity
+            ) != 0
+            nh = (
+                packed[n_dist + n_dag : -1]
+                .view(np.uint32)
+                .reshape(s, self.node_capacity, n_words)
             )
-            slot_of = {n: i for i, n in enumerate(neighbors)}
-            slot_names.append(neighbors)
-            for e in edges_by_src.get(src_id, ()):
-                v = self.node_names[int(self.edge_dst[e])]
-                edge_slot[row, e] = slot_of[v]
-        return edge_slot, slot_names
+        else:
+            dist = np.asarray(dist_j)
+            dag = np.asarray(dag_j)
+            nh = np.asarray(nh_j)
+        return self.to_spf_results(sources, dist, dag, nh)
+
+    def _max_slots_of(self, sources: list[str]) -> int:
+        """Max distinct out-neighbors over the batch's sources — sizes the
+        first-hop bitmask words for this call."""
+        links_of = self._links_of
+        best = 1
+        for s in sources:
+            n = len({l.other_node_name(s) for l in links_of.get(s, ())})
+            if n > best:
+                best = n
+        return best
 
     @property
     def _links_of(self) -> dict[str, list[Link]]:

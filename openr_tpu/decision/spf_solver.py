@@ -102,35 +102,80 @@ class HostSpfBackend:
 
 
 class DeviceSpfBackend:
-    """Batched TPU SSSP: on first query after a topology change, computes
-    *all* sources in one device call (vmapped frontier relaxation over the
-    CSR mirror) and serves per-source results from that batch.
+    """TPU SPF backend over a persistent CSR/ELL device mirror.
 
-    This replaces the reference's per-source sequential Dijkstra memo
-    (openr/decision/LinkState.h:279-282) with one bulk device pass."""
+    Replaces the reference's per-source sequential Dijkstra memo
+    (openr/decision/LinkState.h:279-282).  Per LinkState it keeps ONE
+    mirror that refreshes incrementally on topology version bumps
+    (attribute flaps touch only the runtime arrays; edge-set changes
+    rebuild tables at stable shapes, so compiled kernels are reused —
+    csr.refresh).  Queries are LAZY: the hot path asks only for the
+    daemon's own node per area (getNextHopsWithMetric), so each uncached
+    source costs one small device call (distances + SP-DAG + bit-packed
+    first hops); batch consumers (what-if, KSP, ctrl any-node queries)
+    go through `prefetch` to amortize one call over many sources.
 
-    def __init__(self) -> None:
+    Below `min_device_nodes` the host Dijkstra memo is served instead —
+    kernel dispatch overhead beats graph work on tiny topologies."""
+
+    def __init__(self, min_device_nodes: int = 64) -> None:
+        self.min_device_nodes = min_device_nodes
         # Keyed on the LinkState object itself (weakly) rather than id():
-        # ids are recycled after GC, so an id-keyed cache could serve another
-        # topology's results and leaks entries for dead LinkStates.
-        self._cache: "weakref.WeakKeyDictionary[LinkState, tuple[int, dict[str, SpfResult]]]" = (
+        # ids are recycled after GC, so an id-keyed cache could serve
+        # another topology's results and leaks entries for dead
+        # LinkStates.
+        self._mirrors: "weakref.WeakKeyDictionary[LinkState, object]" = (
+            weakref.WeakKeyDictionary()
+        )
+        self._results: "weakref.WeakKeyDictionary[LinkState, tuple[int, dict[str, SpfResult]]]" = (
             weakref.WeakKeyDictionary()
         )
 
-    def get_spf_result(self, link_state: LinkState, src: str) -> SpfResult:
+    def _mirror(self, link_state: LinkState):
         from .csr import CsrTopology
 
-        cached = self._cache.get(link_state)
-        if cached is None or cached[0] != link_state.version:
+        csr = self._mirrors.get(link_state)
+        if csr is None:
             csr = CsrTopology.from_link_state(link_state)
-            sources = [n for n in link_state.node_names if link_state.links_from_node(n)]
-            results = csr.spf_from(sources) if sources else {}
-            cached = (link_state.version, results)
-            self._cache[link_state] = cached
-        if src not in cached[1]:
+            self._mirrors[link_state] = csr
+        elif csr.version != link_state.version:
+            csr.refresh(link_state)
+        return csr
+
+    def _result_cache(self, link_state: LinkState) -> dict[str, SpfResult]:
+        cached = self._results.get(link_state)
+        if cached is None or cached[0] != link_state.version:
+            cached = (link_state.version, {})
+            self._results[link_state] = cached
+        return cached[1]
+
+    def prefetch(self, link_state: LinkState, sources: list[str]) -> None:
+        """Compute many sources in one device call and cache them."""
+        if link_state.num_nodes() < self.min_device_nodes:
+            return
+        cache = self._result_cache(link_state)
+        missing = [
+            s
+            for s in sources
+            if s not in cache and link_state.links_from_node(s)
+        ]
+        if missing:
+            csr = self._mirror(link_state)
+            cache.update(csr.spf_from(missing))
+
+    def get_spf_result(self, link_state: LinkState, src: str) -> SpfResult:
+        if link_state.num_nodes() < self.min_device_nodes:
+            return link_state.get_spf_result(src)
+        cache = self._result_cache(link_state)
+        hit = cache.get(src)
+        if hit is not None:
+            return hit
+        if not link_state.links_from_node(src):
             # isolated/unknown node: empty-but-self result via host path
             return link_state.get_spf_result(src)
-        return cached[1][src]
+        csr = self._mirror(link_state)
+        cache.update(csr.spf_from([src]))
+        return cache[src]
 
 
 class SpfSolver:

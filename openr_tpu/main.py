@@ -60,7 +60,10 @@ class OpenrDaemon:
         fib_agent: Optional[FibAgent] = None,
         netlink_events_queue: Optional[ReplicateQueue] = None,
         spf_backend: Optional[SpfBackend] = None,
-        use_device_spf: bool = False,
+        # Device SPF is the default: DeviceSpfBackend itself serves tiny
+        # topologies (< min_device_nodes) from the host Dijkstra memo, so
+        # the flag only matters to force pure-host behavior.
+        use_device_spf: bool = True,
         ctrl_port: Optional[int] = None,
         spark_v6_addr: str = "",
     ) -> None:
@@ -326,7 +329,14 @@ def main(argv: Optional[list[str]] = None) -> int:
     parser.add_argument(
         "--use-device-spf",
         action="store_true",
-        help="use the batched TPU SPF backend",
+        default=True,
+        help="use the batched TPU SPF backend (default)",
+    )
+    parser.add_argument(
+        "--no-device-spf",
+        dest="use_device_spf",
+        action="store_false",
+        help="force the host Dijkstra SPF backend",
     )
     args = parser.parse_args(argv)
     logging.basicConfig(

@@ -1,8 +1,29 @@
 from .sssp import (
     INF32,
+    EllBucket,
+    EllGraph,
     batched_sssp,
-    first_hop_matrix,
+    batched_sssp_ell,
+    build_ell,
+    first_hops_ell,
     sp_dag_mask,
+    sp_dag_mask_from_T,
+    spf_forward_ell,
+    spf_forward_ell_masked,
+    spf_forward_full,
 )
 
-__all__ = ["INF32", "batched_sssp", "sp_dag_mask", "first_hop_matrix"]
+__all__ = [
+    "INF32",
+    "EllBucket",
+    "EllGraph",
+    "batched_sssp",
+    "batched_sssp_ell",
+    "build_ell",
+    "first_hops_ell",
+    "sp_dag_mask",
+    "sp_dag_mask_from_T",
+    "spf_forward_ell",
+    "spf_forward_ell_masked",
+    "spf_forward_full",
+]

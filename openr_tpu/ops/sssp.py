@@ -125,60 +125,6 @@ def sp_dag_mask(
     return relax_allowed & (d_u < INF32) & (d_u + edge_metric[None, :] == d_v)
 
 
-@functools.partial(jax.jit, static_argnames=("n_slots",))
-def first_hop_matrix(
-    dag: jax.Array,  # [S, E] bool — SP-DAG membership
-    dist: jax.Array,  # [S, N] int32 (for iteration bound only)
-    edge_src: jax.Array,  # [E]
-    edge_dst: jax.Array,  # [E]
-    edge_slot: jax.Array,  # [S, E] int32 — j if edge e is source-row s's j-th
-    #                         out-edge (first hop slot), else -1
-    n_slots: int,
-) -> jax.Array:
-    """Propagate first-hop membership along the SP-DAG.
-
-    Returns nh [S, N, D] bool: nh[s, v, j] == True iff row s's j-th out-edge
-    begins some shortest path to v — the device form of the reference's
-    per-node `nextHops` sets (runSpf's addNextHops accumulation,
-    LinkState.cpp:855-869).
-    """
-    s_dim, n_nodes = dist.shape
-
-    # init: direct DAG edges out of the source claim their own slot
-    slot_onehot = (
-        jax.nn.one_hot(edge_slot, n_slots, dtype=jnp.bool_)
-        & dag[:, :, None]
-        & (edge_slot >= 0)[:, :, None]
-    )  # [S, E, D]
-    nh0 = jax.vmap(
-        lambda oh, dst: jax.ops.segment_max(
-            oh.astype(jnp.int32), dst, num_segments=n_nodes, indices_are_sorted=True
-        )
-    )(slot_onehot, jnp.broadcast_to(edge_dst, (s_dim, edge_dst.shape[0])))
-    nh0 = nh0.astype(jnp.bool_)  # [S, N, D]
-
-    def cond(state):
-        _, changed, it = state
-        return changed & (it < n_nodes)
-
-    def body(state):
-        nh, _, it = state
-        contrib = jnp.take(nh, edge_src, axis=1) & dag[:, :, None]  # [S, E, D]
-        prop = jax.vmap(
-            lambda c: jax.ops.segment_max(
-                c.astype(jnp.int32),
-                edge_dst,
-                num_segments=n_nodes,
-                indices_are_sorted=True,
-            )
-        )(contrib).astype(jnp.bool_)
-        new = nh | prop
-        return new, jnp.any(new != nh), it + 1
-
-    nh, _, _ = jax.lax.while_loop(cond, body, (nh0, jnp.bool_(True), 0))
-    return nh
-
-
 # ---------------------------------------------------------------------------
 # Degree-bucketed ELL formulation (the production kernel)
 # ---------------------------------------------------------------------------
@@ -263,8 +209,7 @@ def build_ell(
     # slot index of each edge within its destination's in-edge list.
     # Edge arrays are sorted by (dst, src) so in-edges per dst are
     # contiguous; slot = position within the run, ordered by edge id.
-    counts = np.bincount(dst, minlength=n_cap)
-    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    starts = np.concatenate([[0], np.cumsum(deg)[:-1]])
     slot = np.arange(n_edges, dtype=np.int64) - starts[dst]
 
     new_dst = new_of_old[dst].astype(np.int64)  # row in permuted space
@@ -307,7 +252,9 @@ def make_dist0_T(sources: jax.Array, new_of_old: jax.Array, n_cap: int) -> jax.A
     return jnp.where(is_src, jnp.int32(0), INF32)
 
 
-@functools.partial(jax.jit, static_argnames=("unit_metric", "check_every"))
+@functools.partial(
+    jax.jit, static_argnames=("unit_metric", "check_every", "n_sweeps")
+)
 def batched_sssp_ell(
     dist0_T: jax.Array,  # [N_cap, S] int32 (permuted node rows)
     ell: EllGraph,
@@ -316,14 +263,26 @@ def batched_sssp_ell(
     check_every: int = 1,
     edge_up: Optional[jax.Array] = None,  # [E_cap] bool (runtime state)
     node_overloaded: Optional[jax.Array] = None,  # [N_cap] bool, OLD ids
-) -> jax.Array:
+    edge_metric: Optional[jax.Array] = None,  # [E_cap] int32 (runtime state)
+    n_sweeps: Optional[int] = None,
+):
     """Fixed-point ELL relaxation; returns dist_T [N_cap, S] (permuted).
 
-    When `edge_up` / `node_overloaded` are given, slot permissions are
-    derived from them at call time (per-bucket [R] gathers via edge_id —
-    negligible), so link flaps and drain flips never require an ELL
-    rebuild and can never disagree with the tables.  Without them the
-    build-time snapshots baked into `ell` apply.
+    With `n_sweeps` (static): runs exactly that many relax sweeps in a
+    `fori_loop` plus one verification sweep, returning
+    `(dist_T, converged)` — NO data-dependent loop.  A `while_loop` with a
+    convergence cond forces a host sync per iteration on latency-bound
+    transports (measured ~6-20ms/iteration over the TPU tunnel), so
+    production callers run fixed sweeps sized by an adaptive per-topology
+    hint and double on a False verdict (csr.CsrTopology.spf_from).
+    Without `n_sweeps`: converges via while_loop and returns dist_T only.
+
+    When `edge_up` / `node_overloaded` / `edge_metric` are given, slot
+    permissions and weights are derived from them at call time (per-bucket
+    [R, K] gathers via edge_id — negligible), so link flaps, drain flips
+    and metric changes never require an ELL rebuild and can never disagree
+    with the tables.  Without them the build-time snapshots baked into
+    `ell` apply.
 
     `row_allowed_T` adds per-(row, edge) exclusions (KSP link masking, SRLG
     what-if) on top of the up/transit conditions.
@@ -340,6 +299,7 @@ def batched_sssp_ell(
     )
     slot_ok: list = []
     slot_transit: list = []
+    slot_w: list = []
     for bk in ell.buckets:
         if edge_up is None:
             ok = bk.ok
@@ -351,8 +311,14 @@ def batched_sssp_ell(
             transit = bk.transit_ok
         else:
             transit = ~jnp.take(overloaded_new, bk.nbr)
+        w = (
+            bk.w
+            if edge_metric is None
+            else jnp.take(edge_metric, jnp.maximum(bk.edge_id, 0))
+        )
         slot_ok.append(ok)
         slot_transit.append(transit)
+        slot_w.append(w)
 
     def relax(dist_T):
         parts = []
@@ -374,13 +340,22 @@ def batched_sssp_ell(
                     allow &= (ej >= 0)[:, None] & jnp.take(
                         row_allowed_T, jnp.maximum(ej, 0), axis=0
                     )
-                metric_j = jnp.int32(1) if unit_metric else bk.w[:, j][:, None]
+                metric_j = (
+                    jnp.int32(1) if unit_metric else slot_w[b][:, j][:, None]
+                )
                 cand = jnp.where(allow & (d_u < INF32), d_u + metric_j, INF32)
                 acc = jnp.minimum(acc, cand)
             parts.append(acc)
             lo += r
         assert lo == n_cap
         return jnp.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
+
+    if n_sweeps is not None:
+        dist_T = jax.lax.fori_loop(
+            0, n_sweeps, lambda i, d: relax(d), dist0_T
+        )
+        verify = relax(dist_T)
+        return verify, jnp.all(verify == dist_T)
 
     def cond(state):
         _, changed, it = state
@@ -464,6 +439,7 @@ def spf_forward_ell(
         unit_metric=not use_link_metric,
         edge_up=edge_up,
         node_overloaded=node_overloaded,
+        edge_metric=edge_metric,
     )
     dist_old_T = ell_dist_to_old_T(dist_T, ell)  # [N_cap, S]
     metric = edge_metric if use_link_metric else jnp.ones_like(edge_metric)
@@ -501,11 +477,222 @@ def spf_forward_ell_masked(
         unit_metric=not use_link_metric,
         edge_up=edge_up,
         node_overloaded=node_overloaded,
+        edge_metric=edge_metric,
     )
     dist_old_T = ell_dist_to_old_T(dist_T, ell)
     metric = edge_metric if use_link_metric else jnp.ones_like(edge_metric)
     dag = sp_dag_mask_from_T(dist_old_T, edge_src, edge_dst, metric, allowed_T)
     return dist_old_T.T, dag
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_words", "check_every", "n_sweeps")
+)
+def first_hops_ell(
+    ell: EllGraph,
+    dag_T: jax.Array,  # [E_cap, S] bool — edge-major SP-DAG (original edge ids)
+    out_slot: jax.Array,  # [E_cap] int32 — slot of edge among its source
+    #   node's sorted unique out-neighbors; -1 for padding
+    sources: jax.Array,  # [S] int32 — original node ids
+    edge_src: jax.Array,  # [E_cap] int32 — original node ids
+    n_words: int,  # ceil(max_slots / 32)
+    check_every: int = 1,
+    n_sweeps: Optional[int] = None,
+):
+    """First-hop sets propagated along the SP-DAG, bit-packed.
+
+    With static `n_sweeps`: fixed fori_loop + one verification sweep,
+    returning (nh, converged) — same host-sync rationale as
+    `batched_sssp_ell`.  Without: while_loop to fixed point, returns nh.
+
+    Returns nh [S, N_cap, n_words] uint32 (ORIGINAL node ids): bit b of
+    word w is set for (s, v) iff slot (32w + b) — an out-neighbor of row
+    s's source — begins some shortest path to v.  Device replacement for
+    the reference's per-node nextHops accumulation (runSpf addNextHops,
+    LinkState.cpp:855-869); the host only decodes bits afterwards.
+
+    Gather-only (no scatters): propagation gathers predecessor masks
+    through the ELL in-edge tables; an edge leaving the row's own source
+    contributes its own out-slot bit instead of the predecessor mask."""
+    n_cap = ell.new_of_old.shape[0]
+    s_dim = sources.shape[0]
+
+    # per-edge initial contribution: if the edge leaves the row's source,
+    # its out-slot bit, else 0 (computed lazily per slot below)
+    is_src_edge = edge_src[:, None] == sources[None, :]  # [E_cap, S]
+
+    def relax(nh_T):
+        # nh_T: [N_cap, S, W] uint32, permuted rows
+        parts = []
+        lo = 0
+        for b, bk in enumerate(ell.buckets):
+            r, k = bk.nbr.shape
+            acc = jax.lax.slice_in_dim(nh_T, lo, lo + r, axis=0)
+            for j in range(k):
+                ej = jnp.maximum(bk.edge_id[:, j], 0)
+                on_dag = jnp.take(dag_T, ej, axis=0) & (
+                    bk.edge_id[:, j] >= 0
+                )[:, None]  # [R, S]
+                from_src = jnp.take(is_src_edge, ej, axis=0)  # [R, S]
+                # source-edge contribution: the edge's own slot bit
+                slot = jnp.take(out_slot, ej)  # [R]
+                word_idx = jnp.maximum(slot, 0) // 32  # [R]
+                bit = jnp.where(
+                    slot >= 0,
+                    jnp.uint32(1) << (jnp.maximum(slot, 0) % 32).astype(jnp.uint32),
+                    jnp.uint32(0),
+                )  # [R]
+                src_words = jnp.where(
+                    word_idx[:, None] == jnp.arange(n_words)[None, :],
+                    bit[:, None],
+                    jnp.uint32(0),
+                )  # [R, W]
+                pred = jnp.take(nh_T, bk.nbr[:, j], axis=0)  # [R, S, W]
+                contrib = jnp.where(
+                    (on_dag & from_src)[:, :, None],
+                    src_words[:, None, :],
+                    jnp.where(on_dag[:, :, None], pred, jnp.uint32(0)),
+                )
+                acc = acc | contrib
+            parts.append(acc)
+            lo += r
+        assert lo == n_cap
+        return jnp.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
+
+    nh0 = jnp.zeros((n_cap, s_dim, n_words), dtype=jnp.uint32)
+
+    def to_original(nh_T):
+        # permute rows back to original ids, reorder to [S, N, W]
+        return jnp.take(nh_T, ell.new_of_old, axis=0).transpose(1, 0, 2)
+
+    if n_sweeps is not None:
+        nh_T = jax.lax.fori_loop(0, n_sweeps, lambda i, x: relax(x), nh0)
+        verify = relax(nh_T)
+        return to_original(verify), jnp.all(verify == nh_T)
+
+    def cond(state):
+        _, changed, it = state
+        return changed & (it < n_cap)
+
+    def body(state):
+        nh_T, _, it = state
+        new = nh_T
+        for _ in range(check_every):
+            new = relax(new)
+        return new, jnp.any(new != nh_T), it + check_every
+
+    nh_T, _, _ = jax.lax.while_loop(cond, body, (nh0, jnp.bool_(True), 0))
+    return to_original(nh_T)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("use_link_metric", "n_words", "check_every", "n_sweeps"),
+)
+def spf_forward_full(
+    sources: jax.Array,  # [S] int32
+    ell: EllGraph,
+    edge_src: jax.Array,
+    edge_dst: jax.Array,
+    edge_metric: jax.Array,
+    edge_up: jax.Array,
+    node_overloaded: jax.Array,
+    out_slot: jax.Array,  # [E_cap] int32
+    n_words: int,
+    use_link_metric: bool = True,
+    check_every: int = 1,
+    n_sweeps: Optional[int] = None,
+):
+    """Distances + SP-DAG + bit-packed first-hop sets in ONE device call —
+    the full production forward for route building.
+
+    With static `n_sweeps`: both fixed-point loops run fixed sweeps and
+    the call returns (dist, dag, nh, converged) with a single combined
+    convergence verdict (see batched_sssp_ell's host-sync rationale)."""
+    n_cap = node_overloaded.shape[0]
+    dist_out = batched_sssp_ell(
+        make_dist0_T(sources, ell.new_of_old, n_cap),
+        ell,
+        unit_metric=not use_link_metric,
+        check_every=check_every,
+        edge_up=edge_up,
+        node_overloaded=node_overloaded,
+        edge_metric=edge_metric,
+        n_sweeps=n_sweeps,
+    )
+    if n_sweeps is not None:
+        dist_T, dist_ok = dist_out
+    else:
+        dist_T, dist_ok = dist_out, None
+    dist_old_T = ell_dist_to_old_T(dist_T, ell)
+    metric = edge_metric if use_link_metric else jnp.ones_like(edge_metric)
+    allowed_T = make_relax_allowed_T(sources, edge_src, edge_up, node_overloaded)
+    d_u = jnp.take(dist_old_T, edge_src, axis=0)
+    d_v = jnp.take(dist_old_T, edge_dst, axis=0)
+    dag_T = allowed_T & (d_u < INF32) & (d_u + metric[:, None] == d_v)
+    nh_out = first_hops_ell(
+        ell,
+        dag_T,
+        out_slot,
+        sources,
+        edge_src,
+        n_words,
+        check_every=check_every,
+        n_sweeps=n_sweeps,
+    )
+    if n_sweeps is not None:
+        nh, nh_ok = nh_out
+        return dist_old_T.T, dag_T.T, nh, dist_ok & nh_ok
+    return dist_old_T.T, dag_T.T, nh_out
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("use_link_metric", "n_words", "check_every", "n_sweeps"),
+)
+def spf_forward_full_packed(
+    sources: jax.Array,
+    ell: EllGraph,
+    edge_src: jax.Array,
+    edge_dst: jax.Array,
+    edge_metric: jax.Array,
+    edge_up: jax.Array,
+    node_overloaded: jax.Array,
+    out_slot: jax.Array,
+    n_words: int,
+    use_link_metric: bool = True,
+    check_every: int = 1,
+    n_sweeps: Optional[int] = None,
+) -> jax.Array:
+    """`spf_forward_full` with (dist, dag, nh[, converged]) flattened into
+    ONE int32 buffer, so the host needs a single device->host transfer.
+    Matters for small-S control-plane queries where per-transfer latency
+    dominates (each fetch is a tunnel round trip); callers unpack by known
+    sizes.  With `n_sweeps`, the final element is the convergence verdict
+    (1 = fixed point reached)."""
+    out = spf_forward_full(
+        sources,
+        ell,
+        edge_src,
+        edge_dst,
+        edge_metric,
+        edge_up,
+        node_overloaded,
+        out_slot,
+        n_words,
+        use_link_metric=use_link_metric,
+        check_every=check_every,
+        n_sweeps=n_sweeps,
+    )
+    dist, dag, nh = out[0], out[1], out[2]
+    parts = [
+        dist.ravel(),
+        dag.ravel().astype(jnp.int32),
+        jax.lax.bitcast_convert_type(nh, jnp.int32).ravel(),
+    ]
+    if n_sweeps is not None:
+        parts.append(out[3].astype(jnp.int32)[None])
+    return jnp.concatenate(parts)
 
 
 @functools.partial(jax.jit, static_argnames=("use_link_metric",))

@@ -65,7 +65,11 @@ def spf_step_sharded(mesh: Mesh):
             ops.make_dist0_T(sources, ell.new_of_old, n_cap), s_dist_t
         )
         dist_t = ops.batched_sssp_ell(
-            dist0_t, ell, edge_up=edge_up, node_overloaded=node_overloaded
+            dist0_t,
+            ell,
+            edge_up=edge_up,
+            node_overloaded=node_overloaded,
+            edge_metric=edge_metric,
         )
         dist_old_t = ops.ell_dist_to_old_T(dist_t, ell)
         allowed_t = ops.make_relax_allowed_T(

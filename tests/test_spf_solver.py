@@ -515,8 +515,8 @@ class TestDeviceBackendParity:
         ps.update_prefix("n9", "0", PrefixEntry(prefix="::a:0/112"))
 
         host = SpfSolver("n0").build_route_db({"0": ls}, ps)
-        dev = SpfSolver("n0", spf_backend=DeviceSpfBackend()).build_route_db(
-            {"0": ls}, ps
-        )
+        dev = SpfSolver(
+            "n0", spf_backend=DeviceSpfBackend(min_device_nodes=1)
+        ).build_route_db({"0": ls}, ps)
         assert host.unicast_routes == dev.unicast_routes
         assert host.mpls_routes == dev.mpls_routes

@@ -125,50 +125,48 @@ class TestKernelParity:
 
 
 class TestDeviceFirstHops:
-    """first_hop_matrix on device must agree with oracle next_hops."""
+    """first_hops_ell bitmasks decoded via to_spf_results must agree with
+    oracle next_hops — including a wide-degree source crossing the 32-bit
+    word boundary."""
 
     @pytest.mark.parametrize("seed", [0, 5])
     def test_random(self, seed):
-        import jax.numpy as jnp
-
-        from openr_tpu.ops import first_hop_matrix
-        from openr_tpu.ops.sssp import (
-            batched_sssp,
-            make_dist0,
-            make_relax_allowed,
-            sp_dag_mask,
-        )
-
         ls = build(random_topology(18, 22, seed=seed))
         csr = CsrTopology.from_link_state(ls)
-        sources = ls.node_names
-        src_ids = jnp.asarray([csr.node_id[s] for s in sources], dtype=jnp.int32)
-        e_src = jnp.asarray(csr.edge_src)
-        e_dst = jnp.asarray(csr.edge_dst)
-        metric = jnp.asarray(csr.edge_metric)
-        allowed = make_relax_allowed(
-            src_ids, e_src, jnp.asarray(csr.edge_up), jnp.asarray(csr.node_overloaded)
-        )
-        dist = batched_sssp(
-            make_dist0(src_ids, csr.node_capacity), e_src, e_dst, metric, allowed
-        )
-        dag = sp_dag_mask(dist, e_src, e_dst, metric, allowed)
-        edge_slot, slot_names = csr.build_edge_slots(sources)
-        n_slots = max(1, csr.max_degree)
-        nh = np.asarray(
-            first_hop_matrix(
-                dag, dist, e_src, e_dst, jnp.asarray(edge_slot), n_slots
-            )
-        )
-        for row, src in enumerate(sources):
+        results = csr.spf_from(ls.node_names)
+        for src in ls.node_names:
             oracle = ls.run_spf(src)
             for node, o in oracle.items():
-                if node == src:
-                    continue
-                nid = csr.node_id[node]
-                got = {
-                    slot_names[row][j]
-                    for j in range(len(slot_names[row]))
-                    if nh[row, nid, j]
-                }
-                assert got == o.next_hops, (src, node, got, o.next_hops)
+                assert results[src][node].next_hops == o.next_hops, (
+                    src,
+                    node,
+                )
+
+    def test_multiword_bitmask(self):
+        """Hub with 70 spokes: 3 uint32 words of first-hop slots."""
+        from test_link_state import adj, adj_db
+
+        n_leaves = 70
+        dbs = [
+            adj_db("hub", [adj("hub", f"leaf{i:02d}") for i in range(n_leaves)])
+        ]
+        for i in range(n_leaves):
+            adjs = [adj(f"leaf{i:02d}", "hub")]
+            # chain leaves into a cycle so leaf->leaf has 2 equal paths
+            j = (i + 1) % n_leaves
+            adjs.append(adj(f"leaf{i:02d}", f"leaf{j:02d}"))
+            k = (i - 1) % n_leaves
+            adjs.append(adj(f"leaf{i:02d}", f"leaf{k:02d}"))
+            dbs.append(adj_db(f"leaf{i:02d}", adjs))
+        ls = build(dbs)
+        csr = CsrTopology.from_link_state(ls)
+        results = csr.spf_from(["hub", "leaf00"])
+        for src in ("hub", "leaf00"):
+            oracle = ls.run_spf(src)
+            for node, o in oracle.items():
+                assert results[src][node].next_hops == o.next_hops, (
+                    src,
+                    node,
+                    results[src][node].next_hops,
+                    o.next_hops,
+                )
