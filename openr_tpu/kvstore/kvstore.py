@@ -401,9 +401,132 @@ class KvStoreDb:
         self._publication_buffer: dict[Optional[str], set[str]] = {}
         self._pending_flood_timer = None
         self.counters: dict[str, int] = {}
+        # DUAL flood-topology (reference: KvStoreDb extends DualNode,
+        # KvStore.h:191; hooks at :309 sendDualMessages and :337
+        # processNexthopChange).  Composed rather than inherited.
+        from .dual import DualNode
+
+        self.dual = DualNode(
+            store.node_id,
+            is_root=store.enable_flood_optimization and store.is_flood_root,
+            send_dual_messages=self._send_dual_messages,
+            process_nexthop_change=self._process_nexthop_change,
+        )
 
     def _bump(self, counter: str, n: int = 1) -> None:
         self.counters[counter] = self.counters.get(counter, 0) + n
+
+    # -- DUAL flood-topology --------------------------------------------------
+
+    def _send_dual_messages(self, neighbor: str, msgs) -> bool:
+        """DualNode I/O hook (reference: KvStoreDb::sendDualMessages,
+        KvStore.cpp:3117)."""
+        peer = self.peers.get(neighbor)
+        if peer is None:
+            log.warning("dual: no peer %s to send messages to", neighbor)
+            return False
+        self._bump("kvstore.dual.num_pkt_sent")
+        self.store._spawn(self._dual_to_peer(peer, msgs))
+        return True
+
+    async def _dual_to_peer(self, peer: KvStorePeer, msgs) -> None:
+        try:
+            await self.store.transport.dual_messages(peer.spec, self.area, msgs)
+        except Exception:
+            self._bump("kvstore.dual.num_pkt_send_failure")
+
+    def _process_nexthop_change(
+        self, root_id: str, old_nh: Optional[str], new_nh: Optional[str]
+    ) -> None:
+        """SPT parent changed: (un)register as child remotely + full-sync
+        with the new parent (reference: KvStoreDb::processNexthopChange,
+        KvStore.cpp:2310-2363)."""
+        from ..types import FloodTopoSetParams
+
+        log.info(
+            "dual nexthop change: root-id (%s) %s -> %s",
+            root_id,
+            old_nh or "none",
+            new_nh or "none",
+        )
+        if new_nh is not None:
+            peer = self.peers.get(new_nh)
+            if peer is not None:
+                self._send_topo_set(
+                    peer, FloodTopoSetParams(
+                        root_id=root_id,
+                        src_id=self.store.node_id,
+                        set_child=True,
+                    )
+                )
+                # full-sync with the new parent so the SPT edge is never a
+                # disconnected state (reference enqueues peersToSyncWith_)
+                if peer.spec.state != KvStorePeerState.IDLE:
+                    peer.spec.state = KvStorePeerState.IDLE
+                self._schedule_sync(0.0)
+        if old_nh is not None:
+            peer = self.peers.get(old_nh)
+            if peer is not None:
+                self._send_topo_set(
+                    peer, FloodTopoSetParams(
+                        root_id=root_id,
+                        src_id=self.store.node_id,
+                        set_child=False,
+                    )
+                )
+
+    def _send_topo_set(self, peer: KvStorePeer, params) -> None:
+        self.store._spawn(self._topo_set_to_peer(peer, params))
+
+    async def _topo_set_to_peer(self, peer: KvStorePeer, params) -> None:
+        try:
+            await self.store.transport.flood_topo_set(
+                peer.spec, self.area, params
+            )
+        except Exception:
+            self._bump("kvstore.dual.num_topo_set_failure")
+
+    def process_dual_messages(self, msgs) -> None:
+        """Peer-facing entry (reference: KvStore.cpp:906-923)."""
+        self._bump("kvstore.dual.num_pkt_recv")
+        self.dual.process_dual_messages(msgs)
+
+    def process_flood_topo_set(self, params) -> None:
+        """FLOOD_TOPO_SET (reference: KvStoreDb::processFloodTopoSet,
+        KvStore.cpp:2231-2263)."""
+        if params.all_roots and not params.set_child:
+            for dual in self.dual.duals.values():
+                dual.remove_child(params.src_id)
+            return
+        if not self.dual.has_dual(params.root_id):
+            log.error("processFloodTopoSet unknown root-id %s", params.root_id)
+            return
+        dual = self.dual.get_dual(params.root_id)
+        if params.set_child:
+            dual.add_child(params.src_id)
+        else:
+            dual.remove_child(params.src_id)
+
+    def process_flood_topo_get(self):
+        """FLOOD_TOPO_GET (reference: KvStoreDb::processFloodTopoGet,
+        KvStore.cpp:2195-2228)."""
+        from ..types import SptInfo, SptInfos
+
+        from .dual import DualState
+
+        infos = SptInfos()
+        for root_id, dual in self.dual.duals.items():
+            info = dual.info
+            infos.infos[root_id] = SptInfo(
+                passive=info.sm.state == DualState.PASSIVE,
+                cost=info.distance,
+                parent=info.nexthop,
+                children=sorted(dual.children()),
+            )
+        root_id = self.dual.get_spt_root_id()
+        infos.flood_root_id = root_id
+        infos.flood_peers = sorted(self._flood_peers(root_id))
+        return infos
 
     # -- reads ---------------------------------------------------------------
 
@@ -598,10 +721,18 @@ class KvStoreDb:
             self._bump("kvstore.thrift.num_flood_pub_failure")
 
     def _flood_peers(self, flood_root_id: Optional[str]) -> list[str]:
-        """Flood-topology: all peers, or the SPT neighbors when DUAL flood
-        optimization is enabled (reference: getFloodPeers)."""
-        del flood_root_id  # DUAL flood trees: full-mesh flooding for now
-        return list(self.peers)
+        """SPT-constrained flood peers, falling back to full mesh when the
+        optimization is off or no valid SPT exists (reference:
+        KvStoreDb::getFloodPeers, KvStore.cpp:2813-2834)."""
+        spt_peers = self.dual.get_spt_peers(flood_root_id)
+        flood_to_all = (
+            not self.store.enable_flood_optimization or not spt_peers
+        )
+        return [
+            name
+            for name in self.peers
+            if flood_to_all or name in spt_peers
+        ]
 
     def _buffer_publication(self, pub: Publication) -> None:
         self._bump("kvstore.rate_limit_suppress")

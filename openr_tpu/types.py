@@ -454,3 +454,64 @@ def adj_key(node: str) -> str:
 ADJ_MARKER = "adj:"
 PREFIX_MARKER = "prefix:"
 TTL_INFINITY = -1
+
+
+# -- DUAL flood-topology wire types (reference: openr/if/Types.thrift:461-846)
+
+
+class DualMessageType(enum.IntEnum):
+    """Reference: thrift::DualMessageType (Types.thrift:461-468)."""
+
+    UPDATE = 1
+    QUERY = 2
+    REPLY = 3
+
+
+@dataclass(slots=True)
+class DualMessage:
+    """One DUAL protocol message for a given root
+    (reference: thrift::DualMessage, Types.thrift:470-485)."""
+
+    dst_id: str = ""  # root id this message is about
+    distance: int = 0  # sender's report distance (INT64_MAX = infinity)
+    type: DualMessageType = DualMessageType.UPDATE
+
+
+@dataclass(slots=True)
+class DualMessages:
+    """Batch of DUAL messages from one neighbor
+    (reference: thrift::DualMessages, Types.thrift:490-500)."""
+
+    src_id: str = ""
+    messages: list[DualMessage] = field(default_factory=list)
+
+
+@dataclass(slots=True)
+class FloodTopoSetParams:
+    """Set/unset myself as a child of a peer's SPT
+    (reference: thrift::FloodTopoSetParams, Types.thrift:787-805)."""
+
+    root_id: str = ""
+    src_id: str = ""
+    set_child: bool = False
+    all_roots: Optional[bool] = None
+
+
+@dataclass(slots=True)
+class SptInfo:
+    """Per-root SPT view (reference: thrift::SptInfo, Types.thrift:819-835)."""
+
+    passive: bool = False
+    cost: int = 0
+    parent: Optional[str] = None
+    children: list[str] = field(default_factory=list)
+
+
+@dataclass(slots=True)
+class SptInfos:
+    """FLOOD_TOPO_GET response
+    (reference: thrift::SptInfos, Types.thrift:838-860)."""
+
+    infos: dict[str, SptInfo] = field(default_factory=dict)
+    flood_root_id: Optional[str] = None
+    flood_peers: list[str] = field(default_factory=list)

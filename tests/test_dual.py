@@ -1,0 +1,297 @@
+"""DUAL flood-topology tests (modeled on openr/dual/tests/DualTest.cpp:
+state-machine table, message-passing fixtures over synthetic graphs, and
+SPT validation after every link flap / cost change)."""
+
+from __future__ import annotations
+
+import heapq
+import random
+from collections import deque
+
+from openr_tpu.kvstore.dual import (
+    INFINITY64,
+    DualEvent,
+    DualNode,
+    DualState,
+    DualStateMachine,
+)
+from openr_tpu.types import DualMessages
+
+
+class TestStateMachine:
+    """Reference: TEST(Dual, StateMachine) — the full transition table
+    (Dual.cpp:12-60)."""
+
+    def t(self, start, event, fc, expected):
+        sm = DualStateMachine()
+        sm.state = start
+        sm.process_event(event, fc)
+        assert sm.state == expected, (start, event, fc)
+
+    def test_passive(self):
+        self.t(DualState.PASSIVE, DualEvent.OTHERS, True, DualState.PASSIVE)
+        self.t(DualState.PASSIVE, DualEvent.OTHERS, False, DualState.ACTIVE1)
+        self.t(
+            DualState.PASSIVE,
+            DualEvent.QUERY_FROM_SUCCESSOR,
+            False,
+            DualState.ACTIVE3,
+        )
+        self.t(DualState.PASSIVE, DualEvent.INCREASE_D, False, DualState.ACTIVE1)
+
+    def test_active0(self):
+        self.t(DualState.ACTIVE0, DualEvent.OTHERS, True, DualState.ACTIVE0)
+        self.t(DualState.ACTIVE0, DualEvent.LAST_REPLY, True, DualState.PASSIVE)
+        self.t(DualState.ACTIVE0, DualEvent.LAST_REPLY, False, DualState.ACTIVE2)
+
+    def test_active1(self):
+        self.t(DualState.ACTIVE1, DualEvent.INCREASE_D, True, DualState.ACTIVE0)
+        self.t(DualState.ACTIVE1, DualEvent.LAST_REPLY, True, DualState.PASSIVE)
+        self.t(
+            DualState.ACTIVE1,
+            DualEvent.QUERY_FROM_SUCCESSOR,
+            True,
+            DualState.ACTIVE2,
+        )
+        self.t(DualState.ACTIVE1, DualEvent.OTHERS, False, DualState.ACTIVE1)
+
+    def test_active2(self):
+        self.t(DualState.ACTIVE2, DualEvent.LAST_REPLY, True, DualState.PASSIVE)
+        self.t(DualState.ACTIVE2, DualEvent.LAST_REPLY, False, DualState.ACTIVE3)
+        self.t(DualState.ACTIVE2, DualEvent.INCREASE_D, True, DualState.ACTIVE2)
+
+    def test_active3(self):
+        self.t(DualState.ACTIVE3, DualEvent.LAST_REPLY, True, DualState.PASSIVE)
+        self.t(DualState.ACTIVE3, DualEvent.INCREASE_D, True, DualState.ACTIVE2)
+        self.t(DualState.ACTIVE3, DualEvent.OTHERS, True, DualState.ACTIVE3)
+
+
+class Fabric:
+    """In-memory message fabric connecting DualNodes (reference:
+    DualBaseFixture, DualTest.cpp:269) — queued delivery, pumped to
+    quiescence after each event."""
+
+    def __init__(self):
+        self.nodes: dict[str, DualNode] = {}
+        self.queue: deque = deque()
+        self.links: dict[frozenset, int] = {}  # cost, absent = down
+
+    def add_node(self, node_id: str, is_root: bool = False) -> DualNode:
+        def send(neighbor: str, msgs: DualMessages, me=node_id) -> bool:
+            self.queue.append((neighbor, msgs))
+            return True
+
+        node = DualNode(node_id, is_root, send_dual_messages=send)
+        self.nodes[node_id] = node
+        return node
+
+    def link_up(self, a: str, b: str, cost: int = 1):
+        self.links[frozenset((a, b))] = cost
+        self.nodes[a].peer_up(b, cost)
+        self.nodes[b].peer_up(a, cost)
+        self.pump()
+
+    def link_down(self, a: str, b: str):
+        self.links.pop(frozenset((a, b)), None)
+        self.nodes[a].peer_down(b)
+        self.nodes[b].peer_down(a)
+        self.pump()
+
+    def cost_change(self, a: str, b: str, cost: int):
+        self.links[frozenset((a, b))] = cost
+        self.nodes[a].peer_cost_change(b, cost)
+        self.nodes[b].peer_cost_change(a, cost)
+        self.pump()
+
+    def pump(self):
+        n = 0
+        while self.queue:
+            dst, msgs = self.queue.popleft()
+            self.nodes[dst].process_dual_messages(msgs)
+            n += 1
+            assert n < 100_000, "dual did not converge"
+
+    # -- validation (reference: DualBaseFixture::validate) -----------------
+
+    def dijkstra(self, src: str) -> dict[str, int]:
+        dist = {src: 0}
+        heap = [(0, src)]
+        while heap:
+            d, u = heapq.heappop(heap)
+            if d > dist.get(u, INFINITY64):
+                continue
+            for key, cost in self.links.items():
+                if u in key:
+                    (v,) = key - {u} or {u}
+                    nd = d + cost
+                    if nd < dist.get(v, INFINITY64):
+                        dist[v] = nd
+                        heapq.heappush(heap, (nd, v))
+        return dist
+
+    def validate(self):
+        roots = {n.node_id for n in self.nodes.values() if n.is_root}
+        if not roots:
+            for node in self.nodes.values():
+                assert node.get_spt_root_id() is None
+            return
+        for root in roots:
+            expected = self.dijkstra(root)
+            parent_edges = set()
+            for node in self.nodes.values():
+                info = node.get_info(root)
+                assert info is not None, (root, node.node_id)
+                # converged & passive
+                assert info.sm.state == DualState.PASSIVE, (
+                    root,
+                    node.node_id,
+                    info,
+                )
+                exp = expected.get(node.node_id, INFINITY64)
+                assert info.distance == exp, (root, node.node_id, info, exp)
+                if node.node_id == root:
+                    assert info.nexthop == root
+                    continue
+                if exp == INFINITY64:
+                    continue
+                # parent relationship is distance-consistent
+                parent = info.nexthop
+                assert parent is not None
+                cost = self.links.get(frozenset((node.node_id, parent)))
+                assert cost is not None, (
+                    f"{node.node_id} parent {parent} not a live link"
+                )
+                assert expected[parent] + cost == exp
+                parent_edges.add((node.node_id, parent))
+            # parent pointers form a tree over reachable nodes (SPT)
+            reachable = {
+                n for n in self.nodes if expected.get(n, INFINITY64) < INFINITY64
+            }
+            assert len(parent_edges) == len(reachable) - 1
+
+
+class TestDualTopologies:
+    def test_two_nodes(self):
+        f = Fabric()
+        f.add_node("n0", is_root=True)
+        f.add_node("n1")
+        f.link_up("n0", "n1")
+        f.validate()
+        info = f.nodes["n1"].get_info("n0")
+        assert info.nexthop == "n0" and info.distance == 1
+
+    def test_no_root(self):
+        f = Fabric()
+        f.add_node("n0")
+        f.add_node("n1")
+        f.link_up("n0", "n1")
+        f.validate()
+
+    def test_ring(self):
+        """Reference: ring topology case in DualTest."""
+        f = Fabric()
+        n = 6
+        f.add_node("n0", is_root=True)
+        for i in range(1, n):
+            f.add_node(f"n{i}")
+        for i in range(n):
+            f.link_up(f"n{i}", f"n{(i + 1) % n}")
+        f.validate()
+        # flap every edge down/up, validating each time (DualTest flapping)
+        for i in range(n):
+            a, b = f"n{i}", f"n{(i + 1) % n}"
+            f.link_down(a, b)
+            f.validate()
+            f.link_up(a, b)
+            f.validate()
+
+    def test_star(self):
+        f = Fabric()
+        f.add_node("hub", is_root=True)
+        for i in range(5):
+            f.add_node(f"leaf{i}")
+            f.link_up("hub", f"leaf{i}")
+        f.validate()
+        f.link_down("hub", "leaf2")
+        f.validate()
+        assert f.nodes["leaf2"].get_info("hub").distance == INFINITY64
+
+    def test_multiple_roots_smallest_wins(self):
+        f = Fabric()
+        f.add_node("a", is_root=True)
+        f.add_node("b", is_root=True)
+        f.add_node("c")
+        f.link_up("a", "b")
+        f.link_up("b", "c")
+        f.validate()
+        for node in f.nodes.values():
+            assert node.get_spt_root_id() == "a"
+        # root a dies: everyone falls back to root b
+        f.link_down("a", "b")
+        f.validate()
+        assert f.nodes["c"].get_spt_root_id() == "b"
+
+    def test_cost_changes(self):
+        f = Fabric()
+        f.add_node("r", is_root=True)
+        for x in ("a", "b"):
+            f.add_node(x)
+        f.link_up("r", "a", cost=1)
+        f.link_up("r", "b", cost=10)
+        f.link_up("a", "b", cost=1)
+        f.validate()
+        assert f.nodes["b"].get_info("r").nexthop == "a"  # r-a-b = 2
+        f.cost_change("a", "b", 20)  # now r-b direct = 10
+        f.validate()
+        assert f.nodes["b"].get_info("r").nexthop == "r"
+        f.cost_change("r", "b", 1)
+        f.validate()
+        assert f.nodes["b"].get_info("r").distance == 1
+
+    def test_random_graphs_with_flaps(self):
+        """Reference: DualTest random topology + flap-every-edge sweep."""
+        rng = random.Random(7)
+        for trial in range(3):
+            f = Fabric()
+            n = 8
+            f.add_node("n0", is_root=True)
+            for i in range(1, n):
+                f.add_node(f"n{i}")
+            edges = []
+            # spanning tree + extras
+            for i in range(1, n):
+                j = rng.randrange(i)
+                edges.append((f"n{i}", f"n{j}", rng.randint(1, 5)))
+            for _ in range(4):
+                a, b = rng.sample(range(n), 2)
+                if frozenset((f"n{a}", f"n{b}")) not in {
+                    frozenset((x, y)) for x, y, _ in edges
+                }:
+                    edges.append((f"n{a}", f"n{b}", rng.randint(1, 5)))
+            for a, b, c in edges:
+                f.link_up(a, b, c)
+            f.validate()
+            for a, b, c in edges:
+                f.link_down(a, b)
+                f.validate()
+                f.link_up(a, b, c)
+                f.validate()
+
+    def test_spt_peers(self):
+        """sptPeers = parent + registered children; children mirror the
+        KvStore FLOOD_TOPO_SET flow."""
+        f = Fabric()
+        f.add_node("r", is_root=True)
+        f.add_node("a")
+        f.add_node("b")
+        f.link_up("r", "a")
+        f.link_up("a", "b")
+        # emulate the KvStore layer: each node registers itself as child
+        # of its parent
+        for node_id in ("a", "b"):
+            info = f.nodes[node_id].get_info("r")
+            f.nodes[info.nexthop].get_dual("r").add_child(node_id)
+        f.validate()
+        assert f.nodes["r"].get_dual("r").spt_peers() == {"a", "r"}
+        assert f.nodes["a"].get_dual("r").spt_peers() == {"r", "b"}
+        assert f.nodes["b"].get_dual("r").spt_peers() == {"a"}
