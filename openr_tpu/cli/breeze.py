@@ -87,6 +87,20 @@ def cmd_kvstore_summary(client: CtrlClient, args) -> None:
     _print_json(client.call("getKvStoreAreaSummary"))
 
 
+def cmd_kvstore_floodtopo(client: CtrlClient, args) -> None:
+    """DUAL SPT view (reference: OpenrCtrlHandler
+    semifuture_getSpanningTreeInfos, OpenrCtrlHandler.h:220)."""
+    infos = client.call("getSpanningTreeInfos", area=args.area)
+    print(f"flood-root: {infos.flood_root_id}")
+    print(f"flood-peers: {', '.join(infos.flood_peers) or '(full mesh)'}")
+    rows = [
+        [root, "PASSIVE" if spt.passive else "ACTIVE", spt.cost,
+         spt.parent or "-", ",".join(spt.children) or "-"]
+        for root, spt in sorted(infos.infos.items())
+    ]
+    _table(rows, ["Root", "State", "Cost", "Parent", "Children"])
+
+
 def cmd_kvstore_snoop(client: CtrlClient, args) -> None:
     """Stream KvStore deltas (reference: KvStoreSnooper tool)."""
     for pub in client.stream(
@@ -325,6 +339,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(fn=cmd_kvstore_peers)
     p = kv.add_parser("summary")
     p.set_defaults(fn=cmd_kvstore_summary)
+    p = kv.add_parser("floodtopo")
+    p.add_argument("--area", default="0")
+    p.set_defaults(fn=cmd_kvstore_floodtopo)
     p = kv.add_parser("snoop")
     p.add_argument("--area", default="0")
     p.add_argument("--prefixes", nargs="*")

@@ -31,6 +31,10 @@ class KvStoreConf:
     flood_msg_per_sec: int = 0  # 0 == unlimited
     flood_msg_burst_size: int = 0
     key_prefix_filters: list[str] = field(default_factory=list)
+    # DUAL flood-topology optimization (reference: enable_flood_optimization
+    # / is_flood_root, OpenrConfig.thrift:25 KvstoreConfig)
+    enable_flood_optimization: bool = False
+    is_flood_root: bool = True
 
 
 @register_type

@@ -156,5 +156,20 @@ class TcpKvStoreTransport:
                 "area": area,
                 "key_vals": params.key_vals,
                 "node_ids": params.node_ids,
+                "flood_root_id": params.flood_root_id,
             },
+        )
+
+    async def dual_messages(self, peer: PeerSpec, area: str, msgs) -> None:
+        await self._call(
+            peer,
+            "processKvStoreDualMessage",
+            {"area": area, "messages": msgs},
+        )
+
+    async def flood_topo_set(self, peer: PeerSpec, area: str, params) -> None:
+        await self._call(
+            peer,
+            "updateFloodTopologyChild",
+            {"area": area, "params": params},
         )

@@ -103,6 +103,18 @@ class OpenrCtrlHandler:
             self.kvstore, "kvstore"
         ).dump_peers(p.get("area", "0"))
         m["getKvStoreAreaSummary"] = self._kvstore_summary
+        # DUAL flood-topology (reference: OpenrCtrl.thrift getSpanningTreeInfos
+        # + updateFloodTopologyChild; dual messages rode the ZMQ channel in
+        # the reference, here they are plain ctrl methods)
+        m["processKvStoreDualMessage"] = lambda p: self._need(
+            self.kvstore, "kvstore"
+        ).process_dual_messages(p.get("area", "0"), p["messages"])
+        m["updateFloodTopologyChild"] = lambda p: self._need(
+            self.kvstore, "kvstore"
+        ).process_flood_topo_set(p.get("area", "0"), p["params"])
+        m["getSpanningTreeInfos"] = lambda p: self._need(
+            self.kvstore, "kvstore"
+        ).get_flood_topo(p.get("area", "0"))
 
         # -- decision ---------------------------------------------------------
         m["getRouteDb"] = lambda p: self._need(
@@ -236,6 +248,7 @@ class OpenrCtrlHandler:
             p.get("area", "0"),
             p["key_vals"],
             node_ids=p.get("node_ids"),
+            flood_root_id=p.get("flood_root_id"),
         )
 
     def _kvstore_summary(self, p: dict) -> list[dict]:

@@ -34,6 +34,7 @@ import asyncio
 import enum
 import hashlib
 import heapq
+import logging
 import time
 from dataclasses import dataclass, field
 from typing import Any, Iterable, Optional, Protocol
@@ -41,15 +42,21 @@ from typing import Any, Iterable, Optional, Protocol
 from ..runtime.eventbase import OpenrEventBase
 from ..runtime.queue import QueueClosedError, ReplicateQueue, RQueue
 from ..types import (
+    FloodTopoSetParams,
     KvStorePeerState,
     KvStoreSyncEvent,
     PeerEvent,
     PeerSpec,
     Publication,
+    SptInfo,
+    SptInfos,
     TTL_INFINITY,
     Value,
 )
 from ..utils.backoff import ExponentialBackoff
+from .dual import DualNode, DualState
+
+log = logging.getLogger(__name__)
 
 # reference: Constants.h
 INITIAL_BACKOFF_S = 0.064
@@ -58,6 +65,14 @@ PARALLEL_SYNC_LIMIT_INITIAL = 2
 PARALLEL_SYNC_LIMIT_MAX = 32
 TTL_THRESHOLD_S = 0.5  # Constants::kTtlThreshold (about-to-expire filter)
 FLOOD_PENDING_PUBLICATION_S = 0.1  # Constants::kFloodPendingPublication
+# DUAL over an unreliable per-request transport (the reference's ZMQ peer
+# channel was reliable+ordered; ours is not, so we serialize per-peer and
+# retry until delivery or peer removal, and reconcile with periodic
+# re-assertion + anti-entropy syncs)
+DUAL_SEND_RETRY_INITIAL_S = 0.25
+DUAL_SEND_MAX_BACKOFF_S = 8.0
+SPT_REASSERT_INTERVAL_S = 15.0
+SPT_ANTI_ENTROPY_SYNC_S = 60.0
 
 
 def generate_hash(version: int, originator_id: str, value: Optional[bytes]) -> int:
@@ -229,6 +244,12 @@ class KvStoreTransport(Protocol):
         self, peer: PeerSpec, area: str, params: KeySetParams
     ) -> None: ...
 
+    async def dual_messages(self, peer: PeerSpec, area: str, msgs) -> None: ...
+
+    async def flood_topo_set(
+        self, peer: PeerSpec, area: str, params
+    ) -> None: ...
+
 
 class TransportError(RuntimeError):
     pass
@@ -290,6 +311,22 @@ class _BoundInProcessTransport:
         await asyncio.wrap_future(
             store.run_in_event_base_thread(
                 lambda: store._db(area).process_key_set_request(params)
+            )
+        )
+
+    async def dual_messages(self, peer: PeerSpec, area: str, msgs) -> None:
+        store = self._fabric._target(self.addr, peer)
+        await asyncio.wrap_future(
+            store.run_in_event_base_thread(
+                lambda: store._db(area).process_dual_messages(msgs)
+            )
+        )
+
+    async def flood_topo_set(self, peer: PeerSpec, area: str, params) -> None:
+        store = self._fabric._target(self.addr, peer)
+        await asyncio.wrap_future(
+            store.run_in_event_base_thread(
+                lambda: store._db(area).process_flood_topo_set(params)
             )
         )
 
@@ -377,6 +414,21 @@ class KvStorePeer:
     spec: PeerSpec
     backoff: ExponentialBackoff
     in_flight: bool = False
+    # keys flooded while this peer was not yet INITIALIZED; flushed on sync
+    # completion.  The reference silently drops these (floodPublication skips
+    # non-initialized peers and the full-sync digest was snapshotted at
+    # request time), leaving a loss window that its deployments paper over
+    # with KvStoreClientInternal persist-key refresh; we close it instead.
+    pending_flood_keys: set[str] = field(default_factory=set)
+    # FIFO lock serializing DUAL/flood-topo sends to this peer so retries
+    # cannot reorder an older message after a newer one
+    send_lock: asyncio.Lock = field(default_factory=asyncio.Lock)
+    # whether this peer has ever spoken DUAL to us.  A flood-opt-disabled
+    # peer never does, and must keep receiving full-mesh floods even once
+    # our SPT is valid — otherwise a mixed-config mesh silently starves it.
+    # (The reference assumes a uniform knob; its getFloodPeers comment
+    # mentions "peers-who-does-not-support-dual" but no flag exists.)
+    dual_seen: bool = False
 
 
 class KvStoreDb:
@@ -398,14 +450,17 @@ class KvStoreDb:
             if store.flood_rate
             else None
         )
-        self._publication_buffer: dict[Optional[str], set[str]] = {}
+        # (flood_root_id, learned-from sender) -> buffered key names
+        self._publication_buffer: dict[
+            tuple[Optional[str], Optional[str]], set[str]
+        ] = {}
         self._pending_flood_timer = None
+        self._spt_reassert_timer = None
+        self._anti_entropy_timer = None
         self.counters: dict[str, int] = {}
         # DUAL flood-topology (reference: KvStoreDb extends DualNode,
         # KvStore.h:191; hooks at :309 sendDualMessages and :337
         # processNexthopChange).  Composed rather than inherited.
-        from .dual import DualNode
-
         self.dual = DualNode(
             store.node_id,
             is_root=store.enable_flood_optimization and store.is_flood_root,
@@ -429,11 +484,44 @@ class KvStoreDb:
         self.store._spawn(self._dual_to_peer(peer, msgs))
         return True
 
+    async def _send_reliably(
+        self, peer: KvStorePeer, send_once, failure_counter: str
+    ) -> None:
+        """Reliable+ordered delivery to one peer over the per-request
+        transport: a per-peer FIFO lock prevents a retried older message
+        landing after a newer one, and retries continue (capped backoff)
+        until delivery or until the peer registration is replaced/removed —
+        at which point peer_down/peer_up reconciles DUAL state anyway.
+        Restores the delivery semantics the reference got from its ordered
+        ZMQ peer channel."""
+        async with peer.send_lock:
+            delay = DUAL_SEND_RETRY_INITIAL_S
+            failures = 0
+            while self.peers.get(peer.name) is peer:
+                try:
+                    await send_once()
+                    return
+                except Exception as exc:
+                    self._bump(failure_counter)
+                    failures += 1
+                    if failures % 8 == 1:
+                        log.warning(
+                            "dual: send to %s failing (attempt %d): %r",
+                            peer.name,
+                            failures,
+                            exc,
+                        )
+                    await asyncio.sleep(delay)
+                    delay = min(delay * 2, DUAL_SEND_MAX_BACKOFF_S)
+
     async def _dual_to_peer(self, peer: KvStorePeer, msgs) -> None:
-        try:
-            await self.store.transport.dual_messages(peer.spec, self.area, msgs)
-        except Exception:
-            self._bump("kvstore.dual.num_pkt_send_failure")
+        await self._send_reliably(
+            peer,
+            lambda: self.store.transport.dual_messages(
+                peer.spec, self.area, msgs
+            ),
+            "kvstore.dual.num_pkt_send_failure",
+        )
 
     def _process_nexthop_change(
         self, root_id: str, old_nh: Optional[str], new_nh: Optional[str]
@@ -441,8 +529,6 @@ class KvStoreDb:
         """SPT parent changed: (un)register as child remotely + full-sync
         with the new parent (reference: KvStoreDb::processNexthopChange,
         KvStore.cpp:2310-2363)."""
-        from ..types import FloodTopoSetParams
-
         log.info(
             "dual nexthop change: root-id (%s) %s -> %s",
             root_id,
@@ -479,21 +565,97 @@ class KvStoreDb:
         self.store._spawn(self._topo_set_to_peer(peer, params))
 
     async def _topo_set_to_peer(self, peer: KvStorePeer, params) -> None:
-        try:
-            await self.store.transport.flood_topo_set(
+        await self._send_reliably(
+            peer,
+            lambda: self.store.transport.flood_topo_set(
                 peer.spec, self.area, params
+            ),
+            "kvstore.dual.num_topo_set_failure",
+        )
+
+    def reassert_spt_children(self) -> None:
+        """Re-register as a child with every current SPT parent.
+
+        Belt-and-braces on top of _send_reliably: if our parent restarted or
+        otherwise lost its child table, re-assertion (idempotent set insert)
+        re-attaches us.  No reference equivalent — its ZMQ channel was
+        reliable and peers restarting re-ran the whole peer FSM."""
+        if self._spt_reassert_timer is not None:
+            self._spt_reassert_timer.cancel()
+            self._spt_reassert_timer = None
+        if not self.peers:
+            return  # re-armed by the next add_peers
+        for root_id, dual in self.dual.duals.items():
+            nexthop = dual.info.nexthop
+            if nexthop is None or nexthop == self.store.node_id:
+                continue
+            peer = self.peers.get(nexthop)
+            if peer is None:
+                continue
+            if peer.send_lock.locked():
+                continue  # a send is already pending/retrying; don't pile on
+            self._send_topo_set(
+                peer,
+                FloodTopoSetParams(
+                    root_id=root_id,
+                    src_id=self.store.node_id,
+                    set_child=True,
+                ),
             )
-        except Exception:
-            self._bump("kvstore.dual.num_topo_set_failure")
+        self._spt_reassert_timer = self.store.schedule_timeout(
+            SPT_REASSERT_INTERVAL_S, self.reassert_spt_children
+        )
+
+    def anti_entropy_sync(self) -> None:
+        """Periodic digest full-sync with the SPT parent.
+
+        Publications flooded by the parent while it (transiently) did not
+        know us as a child are skipped silently — we are INITIALIZED, so
+        they are not even captured in pending_flood_keys.  A low-frequency
+        3-way sync (hashes only when already consistent) closes that
+        residual loss window."""
+        if self._anti_entropy_timer is not None:
+            self._anti_entropy_timer.cancel()
+            self._anti_entropy_timer = None
+        if not self.peers:
+            return  # re-armed by the next add_peers
+        root_id = self.dual.get_spt_root_id()
+        parent = (
+            self.dual.get_dual(root_id).info.nexthop
+            if root_id is not None
+            else None
+        )
+        if parent is not None and parent != self.store.node_id:
+            peer = self.peers.get(parent)
+            if peer is not None and peer.spec.state == KvStorePeerState.INITIALIZED:
+                peer.spec.state = KvStorePeerState.IDLE
+                self._schedule_sync(0.0)
+        self._anti_entropy_timer = self.store.schedule_timeout(
+            SPT_ANTI_ENTROPY_SYNC_S, self.anti_entropy_sync
+        )
 
     def process_dual_messages(self, msgs) -> None:
-        """Peer-facing entry (reference: KvStore.cpp:906-923)."""
+        """Peer-facing entry (reference: KvStore.cpp:906-923 — which drops
+        DUAL traffic when the optimization is off, as must we: a disabled
+        node has an empty neighbor view and would wedge enabled queriers
+        waiting on replies that never come)."""
+        if not self.store.enable_flood_optimization:
+            self._bump("kvstore.dual.num_pkt_dropped")
+            return
         self._bump("kvstore.dual.num_pkt_recv")
+        peer = self.peers.get(msgs.src_id)
+        if peer is not None:
+            peer.dual_seen = True
         self.dual.process_dual_messages(msgs)
 
     def process_flood_topo_set(self, params) -> None:
         """FLOOD_TOPO_SET (reference: KvStoreDb::processFloodTopoSet,
         KvStore.cpp:2231-2263)."""
+        if not self.store.enable_flood_optimization:
+            return
+        peer = self.peers.get(params.src_id)
+        if peer is not None:
+            peer.dual_seen = True
         if params.all_roots and not params.set_child:
             for dual in self.dual.duals.values():
                 dual.remove_child(params.src_id)
@@ -510,10 +672,6 @@ class KvStoreDb:
     def process_flood_topo_get(self):
         """FLOOD_TOPO_GET (reference: KvStoreDb::processFloodTopoGet,
         KvStore.cpp:2195-2228)."""
-        from ..types import SptInfo, SptInfos
-
-        from .dual import DualState
-
         infos = SptInfos()
         for root_id, dual in self.dual.duals.items():
             info = dual.info
@@ -651,7 +809,10 @@ class KvStoreDb:
         self._bump("kvstore.updated_key_vals", kv_update_cnt)
         self.update_ttl_countdown_queue(delta)
         if delta.key_vals:
-            self.flood_publication(delta)
+            # sender_id matters when the publication has no node_ids trail
+            # (full-sync responses): without it the delta would be captured
+            # in the sender's pending_flood_keys and echoed straight back
+            self.flood_publication(delta, sender_id=sender_id)
         if need_finalize:
             self.finalize_full_sync(pub.tobe_updated_keys, sender_id)
         return kv_update_cnt
@@ -668,17 +829,33 @@ class KvStoreDb:
         pub: Publication,
         rate_limit: bool = True,
         set_flood_root: bool = True,
+        sender_id: Optional[str] = None,
     ) -> None:
-        """Reference: floodPublication (KvStore.cpp)."""
+        """Reference: floodPublication (KvStore.cpp).
+
+        `sender_id` identifies the peer the publication was learned from
+        when there is no node_ids trail (full-sync responses)."""
+        # Locally-originated updates ride the SPT rooted at the current
+        # flood root (reference: floodPublication stamps floodRootId when
+        # the optimization is on, KvStore.cpp:2841-2864).  Stamped before
+        # the rate-limit buffer so buffered publications keep their SPT
+        # routing instead of falling back to full mesh on flush.
+        if (
+            set_flood_root
+            and pub.flood_root_id is None
+            and self.store.enable_flood_optimization
+        ):
+            pub.flood_root_id = self.dual.get_spt_root_id()
+
         if self._flood_limiter and rate_limit and not self._flood_limiter.consume(1):
-            self._buffer_publication(pub)
+            self._buffer_publication(pub, sender_id)
             if self._pending_flood_timer is None:
                 self._pending_flood_timer = self.store.schedule_timeout(
                     FLOOD_PENDING_PUBLICATION_S, self._flood_buffered
                 )
             return
         if self._publication_buffer:
-            self._buffer_publication(pub)
+            self._buffer_publication(pub, sender_id)
             self._flood_buffered_now()
             return
 
@@ -686,7 +863,8 @@ class KvStoreDb:
         if not pub.key_vals and not pub.expired_keys:
             return
 
-        sender_id = pub.node_ids[-1] if pub.node_ids else None
+        if pub.node_ids:
+            sender_id = pub.node_ids[-1]
         if pub.node_ids is None:
             pub.node_ids = []
         pub.node_ids.append(self.store.node_id)
@@ -709,6 +887,7 @@ class KvStoreDb:
             if peer is None or peer_name == sender_id:
                 continue
             if peer.spec.state != KvStorePeerState.INITIALIZED:
+                peer.pending_flood_keys.update(pub.key_vals)
                 continue
             self._bump("kvstore.thrift.num_flood_pub")
             self.store._spawn(self._flood_to_peer(peer, params))
@@ -728,15 +907,26 @@ class KvStoreDb:
         flood_to_all = (
             not self.store.enable_flood_optimization or not spt_peers
         )
+        # peers that have never spoken DUAL (flood-opt-disabled nodes in a
+        # mixed-config mesh) always get the full flood
         return [
             name
-            for name in self.peers
-            if flood_to_all or name in spt_peers
+            for name, peer in self.peers.items()
+            if flood_to_all or name in spt_peers or not peer.dual_seen
         ]
 
-    def _buffer_publication(self, pub: Publication) -> None:
+    def _buffer_publication(
+        self, pub: Publication, sender_id: Optional[str] = None
+    ) -> None:
         self._bump("kvstore.rate_limit_suppress")
-        buf = self._publication_buffer.setdefault(pub.flood_root_id, set())
+        # keyed by (flood-root, learned-from) so the flush preserves both the
+        # SPT routing and the sender-echo exclusion (the node_ids trail also
+        # ends with the sender when present)
+        if pub.node_ids:
+            sender_id = pub.node_ids[-1]
+        buf = self._publication_buffer.setdefault(
+            (pub.flood_root_id, sender_id), set()
+        )
         buf.update(pub.key_vals)
         buf.update(pub.expired_keys)
 
@@ -749,7 +939,7 @@ class KvStoreDb:
         if not self._publication_buffer:
             return
         buffers, self._publication_buffer = self._publication_buffer, {}
-        for flood_root_id, keys in buffers.items():
+        for (flood_root_id, sender_id), keys in buffers.items():
             pub = Publication(area=self.area, flood_root_id=flood_root_id)
             for key in keys:
                 val = self.kv.get(key)
@@ -757,12 +947,18 @@ class KvStoreDb:
                     pub.key_vals[key] = _copy_value(val)
                 else:
                     pub.expired_keys.append(key)
-            self.flood_publication(pub, rate_limit=False, set_flood_root=False)
+            self.flood_publication(
+                pub,
+                rate_limit=False,
+                set_flood_root=False,
+                sender_id=sender_id,
+            )
 
     # -- full sync ------------------------------------------------------------
 
     def add_peers(self, peers: dict[str, PeerSpec]) -> None:
         """Reference: addThriftPeers (KvStore.cpp:1660+)."""
+        new_names: list[str] = []
         for name, new_spec in peers.items():
             spec = PeerSpec(
                 peer_addr=new_spec.peer_addr,
@@ -778,11 +974,40 @@ class KvStoreDb:
                     spec=spec,
                     backoff=ExponentialBackoff(INITIAL_BACKOFF_S, MAX_BACKOFF_S),
                 )
+                new_names.append(name)
+        # DUAL: every KvStore peering link has unit cost (reference:
+        # KvStore.cpp addPeers -> DualNode::peerUp(peerName, 1)).  A new
+        # peer may have stale child registrations for us from a
+        # non-graceful restart: clear them all first (reference:
+        # unsetChildAll, KvStore.cpp:1796-1800).
+        if self.store.enable_flood_optimization:
+            for name in new_names:
+                peer = self.peers[name]
+                self._send_topo_set(
+                    peer,
+                    FloodTopoSetParams(
+                        root_id="",
+                        src_id=self.store.node_id,
+                        set_child=False,
+                        all_roots=True,
+                    ),
+                )
+                self.dual.peer_up(name, 1)
+            if self._spt_reassert_timer is None:
+                self._spt_reassert_timer = self.store.schedule_timeout(
+                    SPT_REASSERT_INTERVAL_S, self.reassert_spt_children
+                )
+            if self._anti_entropy_timer is None:
+                self._anti_entropy_timer = self.store.schedule_timeout(
+                    SPT_ANTI_ENTROPY_SYNC_S, self.anti_entropy_sync
+                )
         self._schedule_sync(0.0)
 
     def del_peers(self, peers: Iterable[str]) -> None:
         for name in peers:
-            self.peers.pop(name, None)
+            existed = self.peers.pop(name, None)
+            if existed is not None and self.store.enable_flood_optimization:
+                self.dual.peer_down(name)
 
     def dump_peers(self) -> dict[str, PeerSpec]:
         return {name: peer.spec for name, peer in self.peers.items()}
@@ -867,8 +1092,40 @@ class KvStoreDb:
         self._parallel_sync_limit = min(
             2 * self._parallel_sync_limit, PARALLEL_SYNC_LIMIT_MAX
         )
+        # deliver keys flooded while the peer was syncing (see
+        # KvStorePeer.pending_flood_keys)
+        if peer.pending_flood_keys:
+            pending, peer.pending_flood_keys = peer.pending_flood_keys, set()
+            self._flood_keys_to_peer(
+                peer, pending, counter="kvstore.thrift.num_flood_pub"
+            )
         if self.get_peers_by_state(KvStorePeerState.IDLE):
             self._schedule_sync(0.0)
+
+    def _flood_keys_to_peer(
+        self, peer: KvStorePeer, keys: Iterable[str], counter: str
+    ) -> None:
+        """Send the current values of `keys` directly to one peer (used by
+        finalize_full_sync and the pending-flood flush)."""
+        updates = Publication(area=self.area)
+        for key in keys:
+            val = self.kv.get(key)
+            if val is not None:
+                updates.key_vals[key] = _copy_value(val)
+        self.update_publication_ttl(updates)
+        if not updates.key_vals:
+            return
+        self._bump(counter)
+        self.store._spawn(
+            self._flood_to_peer(
+                peer,
+                KeySetParams(
+                    key_vals=updates.key_vals,
+                    node_ids=[self.store.node_id],
+                    timestamp_ms=int(time.time() * 1000),
+                ),
+            )
+        )
 
     def process_sync_failure(self, peer_name: str) -> None:
         """Reference: processThriftFailure (KvStore.cpp:1612-1650)."""
@@ -884,23 +1141,12 @@ class KvStoreDb:
 
     def finalize_full_sync(self, keys: list[str], sender_id: str) -> None:
         """Reference: finalizeFullSync — send back values the peer needs."""
-        updates = Publication(area=self.area)
-        for key in keys:
-            val = self.kv.get(key)
-            if val is not None:
-                updates.key_vals[key] = _copy_value(val)
-        self.update_publication_ttl(updates)
-        if not updates.key_vals:
-            return
         peer = self.peers.get(sender_id)
         if peer is None or peer.spec.state == KvStorePeerState.IDLE:
             return
-        self._bump("kvstore.thrift.num_finalized_sync")
-        params = KeySetParams(
-            key_vals=updates.key_vals,
-            timestamp_ms=int(time.time() * 1000),
+        self._flood_keys_to_peer(
+            peer, keys, counter="kvstore.thrift.num_finalized_sync"
         )
-        self.store._spawn(self._flood_to_peer(peer, params))
 
     # -- TTL ------------------------------------------------------------------
 
@@ -1011,6 +1257,8 @@ class KvStore(OpenrEventBase):
         filters: Optional[KvStoreFilters] = None,
         flood_rate: Optional[tuple[float, float]] = None,  # (msgs/s, burst)
         ttl_decr_ms: int = 1,
+        enable_flood_optimization: bool = False,
+        is_flood_root: bool = True,
     ) -> None:
         super().__init__(name=f"kvstore-{node_id}")
         self.node_id = node_id
@@ -1021,6 +1269,10 @@ class KvStore(OpenrEventBase):
         self.filters = filters
         self.flood_rate = flood_rate
         self.ttl_decr_ms = ttl_decr_ms
+        # DUAL flood-topology knobs (reference: enable_flood_optimization /
+        # is_flood_root in KvStoreConfig, OpenrConfig.thrift:25)
+        self.enable_flood_optimization = enable_flood_optimization
+        self.is_flood_root = is_flood_root
         self._dbs: dict[str, KvStoreDb] = {
             area: KvStoreDb(self, area) for area in areas
         }
@@ -1076,8 +1328,11 @@ class KvStore(OpenrEventBase):
         area: str,
         key_vals: dict[str, Value],
         node_ids: Optional[list[str]] = None,
+        flood_root_id: Optional[str] = None,
     ) -> None:
-        params = KeySetParams(key_vals=key_vals, node_ids=node_ids)
+        params = KeySetParams(
+            key_vals=key_vals, node_ids=node_ids, flood_root_id=flood_root_id
+        )
         self._call(lambda: self._db(area).set_key_vals(params))
 
     def dump_all(
@@ -1121,6 +1376,17 @@ class KvStore(OpenrEventBase):
         self, area: str, peer_name: str
     ) -> Optional[KvStorePeerState]:
         return self._call(lambda: self._db(area).get_peer_state(peer_name))
+
+    # -- DUAL flood-topology API (reference: KvStore.h:268-272) --------------
+
+    def process_dual_messages(self, area: str, msgs) -> None:
+        self._call(lambda: self._db(area).process_dual_messages(msgs))
+
+    def process_flood_topo_set(self, area: str, params) -> None:
+        self._call(lambda: self._db(area).process_flood_topo_set(params))
+
+    def get_flood_topo(self, area: str):
+        return self._call(lambda: self._db(area).process_flood_topo_get())
 
     def get_counters(self) -> dict[str, int]:
         def _sum() -> dict[str, int]:

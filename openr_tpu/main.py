@@ -136,6 +136,8 @@ class OpenrDaemon:
                 else None
             ),
             ttl_decr_ms=kvc.ttl_decrement_ms,
+            enable_flood_optimization=kvc.enable_flood_optimization,
+            is_flood_root=kvc.is_flood_root,
         )
 
         # -- spark (reference: Main.cpp:443-456) -----------------------------

@@ -1,0 +1,340 @@
+"""KvStore + DUAL flood-topology integration.
+
+Models the reference's flood-topo scenarios: KvStoreDb extends DualNode
+(openr/kvstore/KvStore.h:191) so flooding rides per-root spanning trees
+instead of the full peer mesh (getFloodPeers, KvStore.cpp:2813-2834).
+These tests run a real multi-store mesh over the in-process transport and
+assert (a) SPT formation, (b) fanout reduction vs full-mesh flooding,
+(c) fallback to full-mesh when no SPT is valid, and (d) root failover.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from openr_tpu.kvstore.kvstore import (
+    InProcessTransport,
+    KvStore,
+    KvStorePeerState,
+)
+from openr_tpu.runtime.queue import ReplicateQueue
+from openr_tpu.types import PeerSpec, Publication, Value
+
+
+def v(version=1, originator="node", value=b"x", ttl_ms=-1):
+    return Value(
+        version=version, originator_id=originator, value=value, ttl_ms=ttl_ms
+    )
+
+
+def spec(addr: str) -> PeerSpec:
+    return PeerSpec(peer_addr=addr)
+
+
+def wait_for(cond, timeout=5.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def fabric():
+    fab = InProcessTransport()
+    stores = []
+
+    def _make(name, **kw):
+        updates: ReplicateQueue[Publication] = ReplicateQueue()
+        syncs: ReplicateQueue = ReplicateQueue()
+        store = KvStore(
+            name,
+            updates,
+            syncs,
+            None,
+            transport=fab.bind(name),
+            enable_flood_optimization=True,
+            **kw,
+        )
+        fab.register(name, store)
+        store.run()
+        stores.append((store, updates, syncs))
+        return store
+
+    yield fab, _make
+    for store, updates, syncs in stores:
+        updates.close()
+        syncs.close()
+        store.stop()
+    for store, *_ in stores:
+        store.wait_until_stopped(5)
+
+
+def full_mesh(stores):
+    for s in stores:
+        s.add_peers(
+            "0", {o.node_id: spec(o.node_id) for o in stores if o is not s}
+        )
+
+
+def all_initialized(stores):
+    return all(
+        s.get_peer_state("0", o.node_id) == KvStorePeerState.INITIALIZED
+        for s in stores
+        for o in stores
+        if o is not s
+    )
+
+
+def spt_converged(stores, root):
+    """Every store agrees on the flood root and is PASSIVE on it."""
+    for s in stores:
+        infos = s.get_flood_topo("0")
+        if infos.flood_root_id != root:
+            return False
+        spt = infos.infos.get(root)
+        if spt is None or not spt.passive:
+            return False
+        if s.node_id != root and spt.parent is None:
+            return False
+    return True
+
+
+def flood_pub_total(stores):
+    return sum(
+        s.get_counters().get("kvstore.thrift.num_flood_pub", 0) for s in stores
+    )
+
+
+class TestDualFloodTopo:
+    def test_triangle_spt_formation(self, fabric):
+        fab, make = fabric
+        a = make("a", is_flood_root=True)
+        b = make("b", is_flood_root=False)
+        c = make("c", is_flood_root=False)
+        stores = [a, b, c]
+        full_mesh(stores)
+        assert wait_for(lambda: all_initialized(stores))
+        assert wait_for(lambda: spt_converged(stores, "a")), [
+            s.get_flood_topo("0") for s in stores
+        ]
+
+        # triangle rooted at a: b and c hang off a directly (cost 1 < 2)
+        ia, ib, ic = (s.get_flood_topo("0") for s in stores)
+        assert sorted(ia.infos["a"].children) == ["b", "c"]
+        assert ib.infos["a"].parent == "a"
+        assert ic.infos["a"].parent == "a"
+        # a floods to both children; b/c flood only towards a
+        assert sorted(ia.flood_peers) == ["b", "c"]
+        assert ib.flood_peers == ["a"]
+        assert ic.flood_peers == ["a"]
+
+    def test_spt_flooding_fanout_reduced(self, fabric):
+        fab, make = fabric
+        a = make("a", is_flood_root=True)
+        b = make("b", is_flood_root=False)
+        c = make("c", is_flood_root=False)
+        stores = [a, b, c]
+        full_mesh(stores)
+        assert wait_for(lambda: all_initialized(stores))
+        assert wait_for(lambda: spt_converged(stores, "a"))
+
+        before = flood_pub_total(stores)
+        c.set_key_vals("0", {"k": v(originator="c", value=b"fv")})
+        assert wait_for(
+            lambda: b.get_key_vals("0", ["k"]).key_vals.get("k") is not None
+        )
+        assert a.get_key_vals("0", ["k"]).key_vals["k"].value == b"fv"
+        # SPT path is c -> a -> b: exactly 2 peer sends.  Full-mesh flooding
+        # of the same triangle costs 4 (c->{a,b}, a->b, b->a).
+        time.sleep(0.2)  # let any stray relays land
+        assert flood_pub_total(stores) - before == 2
+
+    def test_full_mesh_fallback_before_spt(self, fabric):
+        fab, make = fabric
+        # no node is a root -> no SPT ever forms -> full-mesh flooding
+        a = make("a", is_flood_root=False)
+        b = make("b", is_flood_root=False)
+        stores = [a, b]
+        full_mesh(stores)
+        assert wait_for(lambda: all_initialized(stores))
+        assert a.get_flood_topo("0").flood_root_id is None
+
+        a.set_key_vals("0", {"k": v(originator="a")})
+        assert wait_for(
+            lambda: b.get_key_vals("0", ["k"]).key_vals.get("k") is not None
+        )
+
+    def test_root_failover(self, fabric):
+        fab, make = fabric
+        # two roots: smallest id wins while alive (DualNode::getSptRootId,
+        # Dual.cpp:788-803); survivors fall back to the next root on failure
+        a = make("a", is_flood_root=True)
+        b = make("b", is_flood_root=True)
+        c = make("c", is_flood_root=False)
+        stores = [a, b, c]
+        full_mesh(stores)
+        assert wait_for(lambda: all_initialized(stores))
+        assert wait_for(lambda: spt_converged(stores, "a"))
+
+        # a dies: peers notice (LinkMonitor would drive del_peers in prod)
+        fab.set_partitioned("a", "b", True)
+        fab.set_partitioned("a", "c", True)
+        b.del_peers("0", ["a"])
+        c.del_peers("0", ["a"])
+        assert wait_for(lambda: spt_converged([b, c], "b")), [
+            s.get_flood_topo("0") for s in (b, c)
+        ]
+
+        b.set_key_vals("0", {"after": v(originator="b")})
+        assert wait_for(
+            lambda: c.get_key_vals("0", ["after"]).key_vals.get("after")
+            is not None
+        )
+
+    def test_disabled_store_drops_dual_traffic(self, fabric):
+        """A flood-opt-disabled node must reject DUAL messages (reference:
+        KvStore.cpp:906-923) instead of half-processing them and wedging
+        enabled queriers."""
+        from openr_tpu.kvstore.dual import DualMessage, DualMessages, DualMessageType
+
+        fab, make = fabric
+        updates: ReplicateQueue[Publication] = ReplicateQueue()
+        syncs: ReplicateQueue = ReplicateQueue()
+        off = KvStore(
+            "off",
+            updates,
+            syncs,
+            None,
+            transport=fab.bind("off"),
+            enable_flood_optimization=False,
+        )
+        fab.register("off", off)
+        off.run()
+        try:
+            msgs = DualMessages(
+                src_id="x",
+                messages=[DualMessage(dst_id="x", distance=0)],
+            )
+            off.process_dual_messages("0", msgs)
+            counters = off.get_counters()
+            assert counters.get("kvstore.dual.num_pkt_dropped") == 1
+            assert counters.get("kvstore.dual.num_pkt_recv", 0) == 0
+            assert off.get_flood_topo("0").infos == {}
+        finally:
+            updates.close()
+            syncs.close()
+            off.stop()
+            off.wait_until_stopped(5)
+
+    def test_reassert_heals_lost_child_registration(self, fabric):
+        """A lost FLOOD_TOPO_SET detaches a node from the flood SPT; the
+        periodic re-assert must reconcile it."""
+        fab, make = fabric
+        a = make("a", is_flood_root=True)
+        b = make("b", is_flood_root=False)
+        stores = [a, b]
+        full_mesh(stores)
+        assert wait_for(lambda: all_initialized(stores))
+        assert wait_for(lambda: spt_converged(stores, "a"))
+        assert wait_for(
+            lambda: a.get_flood_topo("0").infos["a"].children == ["b"]
+        )
+
+        # simulate the lost/reordered registration: drop b from a's children
+        a._call(lambda: a._db("0").dual.get_dual("a").remove_child("b"))
+        assert a.get_flood_topo("0").infos["a"].children == []
+
+        # b's re-assert restores it (driven directly instead of waiting out
+        # the 15s timer)
+        b._call(lambda: b._db("0").reassert_spt_children())
+        assert wait_for(
+            lambda: a.get_flood_topo("0").infos["a"].children == ["b"]
+        )
+
+    def test_full_sync_delta_not_echoed_to_sender(self, fabric):
+        """Keys learned from a full-sync response must not be captured in the
+        sender's pending_flood_keys and retransmitted back (sync responses
+        carry no node_ids trail, so exclusion needs the explicit sender)."""
+        fab, make = fabric
+        a = make("a", is_flood_root=True)
+        b = make("b", is_flood_root=False)
+        a.set_key_vals(
+            "0", {f"k{i}": v(originator="a", value=b"x") for i in range(5)}
+        )
+        # b syncs from a: learns 5 keys; a must not receive them back
+        b.add_peers("0", {"a": spec("a")})
+        a.add_peers("0", {"b": spec("b")})
+        assert wait_for(lambda: all_initialized([a, b]))
+        assert wait_for(
+            lambda: len(b.dump_all("0").key_vals) == 5
+        )
+        time.sleep(0.3)  # allow any (wrong) echo to land
+        counters = a.get_counters()
+        # exactly one key-set: a's own local origination.  An echo of the
+        # sync delta from b would bump it to 2.
+        assert counters.get("kvstore.cmd_key_set", 0) == 1, counters
+
+    def test_mixed_config_peer_still_flooded(self, fabric):
+        """A flood-opt-disabled node in an enabled mesh must keep receiving
+        floods: it never speaks DUAL, so it is never in any SPT, and without
+        the dual_seen fallback it would be silently starved once the
+        enabled nodes' SPT became valid."""
+        fab, make = fabric
+        a = make("a", is_flood_root=True)
+        b = make("b", is_flood_root=False)
+        # c has the optimization off
+        updates: ReplicateQueue[Publication] = ReplicateQueue()
+        syncs: ReplicateQueue = ReplicateQueue()
+        c = KvStore(
+            "c",
+            updates,
+            syncs,
+            None,
+            transport=fab.bind("c"),
+            enable_flood_optimization=False,
+        )
+        fab.register("c", c)
+        c.run()
+        try:
+            stores = [a, b, c]
+            full_mesh(stores)
+            assert wait_for(lambda: all_initialized(stores))
+            assert wait_for(lambda: spt_converged([a, b], "a"))
+
+            a.set_key_vals("0", {"mixed": v(originator="a")})
+            assert wait_for(
+                lambda: c.get_key_vals("0", ["mixed"]).key_vals.get("mixed")
+                is not None
+            ), "disabled peer starved of flood"
+            assert b.get_key_vals("0", ["mixed"]).key_vals.get("mixed") is not None
+        finally:
+            updates.close()
+            syncs.close()
+            c.stop()
+            c.wait_until_stopped(5)
+
+    def test_line_topology_spt_matches_line(self, fabric):
+        fab, make = fabric
+        a = make("a", is_flood_root=True)
+        b = make("b", is_flood_root=False)
+        c = make("c", is_flood_root=False)
+        # line a - b - c: c's SPT parent must be b (cost 2 via b)
+        a.add_peers("0", {"b": spec("b")})
+        b.add_peers("0", {"a": spec("a"), "c": spec("c")})
+        c.add_peers("0", {"b": spec("b")})
+        assert wait_for(
+            lambda: all(
+                s.get_peer_state("0", p) == KvStorePeerState.INITIALIZED
+                for s, p in [(a, "b"), (b, "a"), (b, "c"), (c, "b")]
+            )
+        )
+        assert wait_for(lambda: spt_converged([a, b, c], "a"))
+        ic = c.get_flood_topo("0")
+        assert ic.infos["a"].parent == "b"
+        assert ic.infos["a"].cost == 2
+        ib = b.get_flood_topo("0")
+        assert sorted(ib.flood_peers) == ["a", "c"]

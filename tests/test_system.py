@@ -20,6 +20,7 @@ from openr_tpu.cli import breeze
 from openr_tpu.config import (
     AreaConf,
     DecisionConf,
+    KvStoreConf,
     OpenrConfig,
     SparkConf,
     config_from_dict,
@@ -41,13 +42,18 @@ FAST_SPARK = SparkConf(
 )
 
 
-def make_config(name: str, ctrl_port: int = 0) -> OpenrConfig:
+def make_config(
+    name: str, ctrl_port: int = 0, flood_optimization: bool = False
+) -> OpenrConfig:
     return OpenrConfig(
         node_name=name,
         areas=[AreaConf()],
         openr_ctrl_port=ctrl_port,
         spark_config=FAST_SPARK,
         decision_config=DecisionConf(debounce_min_ms=5, debounce_max_ms=20),
+        kvstore_config=KvStoreConf(
+            enable_flood_optimization=flood_optimization
+        ),
         enable_watchdog=False,
         node_label=0,
     ).validate()
@@ -66,7 +72,7 @@ class RingFixture:
     """N daemons in a ring over mock fabrics (reference:
     SimpleRingTopologyFixture)."""
 
-    def __init__(self, n: int):
+    def __init__(self, n: int, flood_optimization: bool = False):
         self.spark_fabric = MockIoProvider()
         self.kv_fabric = InProcessTransport()
         self.daemons: list[OpenrDaemon] = []
@@ -74,7 +80,7 @@ class RingFixture:
             name = f"openr-{i}"
             addr = f"fe80::{name}"
             daemon = OpenrDaemon(
-                make_config(name),
+                make_config(name, flood_optimization=flood_optimization),
                 io_provider=self.spark_fabric.endpoint(name),
                 kvstore_transport=self.kv_fabric.bind(addr),
                 spark_v6_addr=addr,
@@ -173,6 +179,58 @@ class TestRingConvergence:
             } == {"openr-1"}
 
         assert wait_for(direct_only)
+
+
+class TestRingDualFloodTopo:
+    """Ring convergence with DUAL flood-topology on: the reference's
+    flood-optimization posture (KvStoreDb extends DualNode, KvStore.h:191)
+    exercised through full daemons."""
+
+    def test_ring_converges_with_spt_flooding(self):
+        fixture = RingFixture(3, flood_optimization=True)
+        try:
+            daemons = fixture.daemons
+            # routes still converge with SPT-constrained flooding
+            for i, daemon in enumerate(daemons):
+                daemon.prefix_manager.advertise_prefixes(
+                    PrefixType.LOOPBACK,
+                    [PrefixEntry(prefix=f"fc00:{i}::/64")],
+                )
+            for i, daemon in enumerate(daemons):
+                for j in range(3):
+                    if i == j:
+                        continue  # no route to self
+                    assert wait_for(
+                        lambda d=daemon, p=f"fc00:{j}::/64": fixture.prefix_exists(d, p)
+                    ), f"{daemon.config.node_name} missing fc00:{j}::/64"
+
+            # all three are flood roots; smallest id openr-0 wins
+            def spt_done() -> bool:
+                for daemon in daemons:
+                    infos = daemon.kvstore.get_flood_topo("0")
+                    if infos.flood_root_id != "openr-0":
+                        return False
+                return True
+
+            assert wait_for(spt_done), [
+                d.kvstore.get_flood_topo("0") for d in daemons
+            ]
+            # flood fanout: the SPT rooted at openr-0 covers the ring with 2
+            # edges, so every node floods to <= its SPT neighbors, and the
+            # two non-root nodes flood towards a single parent
+            for daemon in daemons[1:]:
+                infos = daemon.kvstore.get_flood_topo("0")
+                spt = infos.infos["openr-0"]
+                assert spt.parent is not None
+                assert len(infos.flood_peers) <= 2
+            total_spt_edges = sum(
+                len(d.kvstore.get_flood_topo("0").flood_peers) for d in daemons
+            )
+            # an SPT over 3 nodes has 2 edges -> 4 directed flood slots;
+            # full-mesh on a 3-ring would be 6
+            assert total_spt_edges == 4, total_spt_edges
+        finally:
+            fixture.stop()
 
 
 class TestTcpSystem:
