@@ -124,6 +124,173 @@ def bench_all_sources(topo, sources, reps, cpp_sample=None):
     }
 
 
+def bench_srlg_whatif(topo, n_variants: int, reps: int, cpp_sample: int) -> dict:
+    """Config #4: batched SRLG what-if — n_variants single-link failure
+    scenarios x 1 source on `topo`, ONE masked-ELL device call (the
+    variant axis IS the batch axis).  The C++ baseline re-runs a full
+    Dijkstra per scenario, which is what the reference would have to do
+    (one Decision re-run per what-if, Decision.cpp:1866)."""
+    from benchmarks import cpp_baseline
+    from openr_tpu.ops import sssp as ops
+    from openr_tpu.ops.protection import build_reverse_edge_ids
+
+    e = topo.n_edges
+    rng = np.random.default_rng(42)
+    rev = np.asarray(
+        build_reverse_edge_ids(topo.edge_src[:e], topo.edge_dst[:e])
+    )
+    fail = rng.integers(0, e, size=n_variants)
+    mask = np.ones((n_variants, topo.edge_capacity), dtype=bool)
+    rows = np.arange(n_variants)
+    mask[rows, fail] = False
+    rev_of_fail = rev[fail]
+    valid = rev_of_fail >= 0
+    mask[rows[valid], rev_of_fail[valid]] = False
+    sources = np.zeros(n_variants, dtype=np.int32)  # router-view what-if
+
+    def run():
+        return ops.spf_forward_ell_masked(
+            sources,
+            topo.ell,
+            topo.edge_src,
+            topo.edge_dst,
+            topo.edge_metric,
+            topo.edge_up,
+            topo.node_overloaded,
+            mask,
+        )
+
+    # parity on a sample of variants vs C++ with the link removed
+    dist, _ = run()
+    dist = np.asarray(dist)
+    for v in range(0, n_variants, max(1, n_variants // 4))[:4]:
+        up = topo.edge_up.copy()
+        up[fail[v]] = False
+        if rev_of_fail[v] >= 0:
+            up[rev_of_fail[v]] = False
+        _, cdist = cpp_baseline.spf_all_sources(
+            topo.n_nodes,
+            topo.edge_src[:e],
+            topo.edge_dst[:e],
+            topo.edge_metric[:e],
+            up[:e],
+            topo.node_overloaded[: topo.n_nodes],
+            np.zeros(1, dtype=np.int32),
+            want_dist=True,
+        )
+        np.testing.assert_array_equal(dist[v, : topo.n_nodes], cdist[0])
+
+    times = _time_device(run, reps)
+
+    # C++ baseline: one full SPF per scenario (sampled + scaled)
+    sample = min(cpp_sample, n_variants)
+    cpp_secs = 0.0
+    for v in range(0, n_variants, n_variants // sample)[:sample]:
+        up = topo.edge_up.copy()
+        up[fail[v]] = False
+        if rev_of_fail[v] >= 0:
+            up[rev_of_fail[v]] = False
+        secs, _ = cpp_baseline.spf_all_sources(
+            topo.n_nodes,
+            topo.edge_src[:e],
+            topo.edge_dst[:e],
+            topo.edge_metric[:e],
+            up[:e],
+            topo.node_overloaded[: topo.n_nodes],
+            np.zeros(1, dtype=np.int32),
+        )
+        cpp_secs += secs
+    scale = n_variants / sample
+    return {
+        "topology": topo.name,
+        "n_variants": n_variants,
+        "n_nodes": topo.n_nodes,
+        "device_ms_min": round(min(times), 3),
+        "device_ms_all": [round(t, 2) for t in times],
+        "cpp_baseline_ms": round(cpp_secs * 1e3 * scale, 3),
+        "cpp_variants_measured": sample,
+        "cpp_scaled": True,
+    }
+
+
+def bench_tilfa(topo, source: int, reps: int) -> dict:
+    """Config #5: TI-LFA backup-path computation at scale — per out-edge
+    post-convergence SPF (+ SP-DAG) for one protected node, one batched
+    device call over the failure dimension."""
+    from benchmarks import cpp_baseline
+    from openr_tpu.ops import protection as prot
+
+    e = topo.n_edges
+    out_edges = np.where(topo.edge_src[:e] == source)[0].astype(np.int32)
+    rev = np.asarray(
+        prot.build_reverse_edge_ids(topo.edge_src[:e], topo.edge_dst[:e])
+    )
+    rev_full = np.full(topo.edge_capacity, -1, dtype=np.int32)
+    rev_full[:e] = rev
+
+    def run():
+        return prot.ti_lfa_backups(
+            np.int32(source),
+            out_edges,
+            topo.edge_src,
+            topo.edge_dst,
+            topo.edge_metric,
+            topo.edge_up,
+            topo.node_overloaded,
+            rev_full,
+            max_degree=len(out_edges),
+        )
+
+    # parity: each row vs C++ with that edge pair down
+    dist, _ = run()
+    dist = np.asarray(dist)
+    for d in range(min(2, len(out_edges))):
+        up = topo.edge_up.copy()
+        up[out_edges[d]] = False
+        if rev[out_edges[d]] >= 0:
+            up[rev[out_edges[d]]] = False
+        _, cdist = cpp_baseline.spf_all_sources(
+            topo.n_nodes,
+            topo.edge_src[:e],
+            topo.edge_dst[:e],
+            topo.edge_metric[:e],
+            up[:e],
+            topo.node_overloaded[: topo.n_nodes],
+            np.asarray([source], dtype=np.int32),
+            want_dist=True,
+        )
+        np.testing.assert_array_equal(dist[d, : topo.n_nodes], cdist[0])
+
+    times = _time_device(run, reps)
+
+    # C++ baseline: one full SPF per protected out-edge
+    cpp_secs = 0.0
+    for d in range(len(out_edges)):
+        up = topo.edge_up.copy()
+        up[out_edges[d]] = False
+        if rev[out_edges[d]] >= 0:
+            up[rev[out_edges[d]]] = False
+        secs, _ = cpp_baseline.spf_all_sources(
+            topo.n_nodes,
+            topo.edge_src[:e],
+            topo.edge_dst[:e],
+            topo.edge_metric[:e],
+            up[:e],
+            topo.node_overloaded[: topo.n_nodes],
+            np.asarray([source], dtype=np.int32),
+        )
+        cpp_secs += secs
+    return {
+        "topology": topo.name,
+        "n_nodes": topo.n_nodes,
+        "protected_out_edges": int(len(out_edges)),
+        "device_ms_min": round(min(times), 3),
+        "device_ms_all": [round(t, 2) for t in times],
+        "cpp_baseline_ms": round(cpp_secs * 1e3, 3),
+        "cpp_scaled": False,
+    }
+
+
 def bench_reconvergence_grid1024() -> dict:
     """End-to-end Decision reconvergence after an adjacency flap on a
     1k-node grid (reference: BM_DecisionGridAdjUpdates,
@@ -221,6 +388,14 @@ def main() -> None:
         wan, np.arange(1024, dtype=np.int32), reps=3, cpp_sample=32
     )
     details["rows"]["allsrc_tile1024_wan100k"] = row_tile
+
+    # --- config #4: batched SRLG what-if, 10k variants x 1k nodes -------
+    details["rows"]["srlg_whatif_10kx1k"] = bench_srlg_whatif(
+        grid, n_variants=10_000, reps=5, cpp_sample=64
+    )
+
+    # --- config #5: TI-LFA backup paths at 100k nodes -------------------
+    details["rows"]["tilfa_wan100k"] = bench_tilfa(wan, source=0, reps=5)
     n_tiles = -(-wan.n_nodes // 1024)
     details["notes"].append(
         f"full all-sources at 100k = {n_tiles} tiles x tile time; the "

@@ -155,6 +155,61 @@ def cmd_decision_received_routes(client: CtrlClient, args) -> None:
     _print_json(client.call("getReceivedRoutesFiltered", prefixes=args.prefixes))
 
 
+def cmd_decision_what_if(client: CtrlClient, args) -> None:
+    """Batched SRLG what-if failure analysis.  Each LINK is "nodeA/nodeB";
+    by default all listed links form ONE scenario (a shared-risk group);
+    --each makes every link its own scenario."""
+    links = []
+    for spec in args.links:
+        if "/" not in spec:
+            print(f"error: bad link spec {spec!r} (expected nodeA/nodeB)")
+            raise SystemExit(2)
+        links.append(tuple(spec.split("/", 1)))
+    scenarios = [[list(l)] for l in links] if args.each else [[list(l) for l in links]]
+    rows = client.call(
+        "decisionWhatIf", scenarios=scenarios, area=args.area
+    )
+    table = []
+    for row in rows:
+        table.append(
+            [
+                row["scenario"],
+                " ".join(f"{a}/{b}" for a, b in row["links"]) or "-",
+                row["newly_unreachable_pairs"],
+                row["degraded_pairs"],
+                " ".join(f"{a}/{b}" for a, b in row["unknown_links"]) or "-",
+            ]
+        )
+    _table(
+        table,
+        ["Scenario", "Failed links", "Unreachable pairs", "Degraded pairs", "Unknown"],
+    )
+
+
+def cmd_decision_tilfa(client: CtrlClient, args) -> None:
+    """Per-adjacency TI-LFA backup analysis for a node."""
+    report = client.call("decisionTiLfa", node=args.node, area=args.area)
+    if "error" in report:
+        print(f"error: {report['error']}")
+        return
+    print(f"node: {report['node']}")
+    rows = []
+    for adj in report["adjacencies"]:
+        rows.append(
+            [
+                adj["neighbor"],
+                adj["protected_destinations"],
+                len(adj["unprotected_destinations"]),
+            ]
+        )
+    _table(rows, ["Failed adjacency", "Protected dests", "Unprotected dests"])
+    if args.verbose:
+        for adj in report["adjacencies"]:
+            print(f"-- via {adj['neighbor']} failed:")
+            for dest, hops in sorted(adj["backup_first_hops"].items()):
+                print(f"   {dest}: {', '.join(hops) or '(none)'}")
+
+
 def cmd_decision_path(client: CtrlClient, args) -> None:
     """Client-side path computation over adj DBs (reference:
     breeze decision path, openr/py/openr/cli/commands/decision.py:293)."""
@@ -361,6 +416,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--src", default="")
     p.add_argument("dst")
     p.set_defaults(fn=cmd_decision_path)
+    p = dec.add_parser("what-if")
+    p.add_argument("links", nargs="+", metavar="LINK", help="nodeA/nodeB")
+    p.add_argument("--each", action="store_true")
+    p.add_argument("--area", default="0")
+    p.set_defaults(fn=cmd_decision_what_if)
+    p = dec.add_parser("tilfa")
+    p.add_argument("node", nargs="?", default="")
+    p.add_argument("--area", default="0")
+    p.add_argument("-v", "--verbose", action="store_true")
+    p.set_defaults(fn=cmd_decision_tilfa)
 
     fib = sub.add_parser("fib").add_subparsers(dest="cmd", required=True)
     p = fib.add_parser("routes")

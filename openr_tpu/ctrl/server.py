@@ -132,6 +132,17 @@ class OpenrCtrlHandler:
             node_name=p.get("node"),
             area_name=p.get("area"),
         )
+        # failure-protection analysis (new capabilities; no reference RPC)
+        m["decisionWhatIf"] = lambda p: self._need(
+            self.decision, "decision"
+        ).what_if(
+            [[tuple(link) for link in sc] for sc in p["scenarios"]],
+            area=p.get("area", "0"),
+            sources=p.get("sources"),
+        )
+        m["decisionTiLfa"] = lambda p: self._need(
+            self.decision, "decision"
+        ).get_ti_lfa(p.get("node", ""), area=p.get("area", "0"))
         m["setRibPolicy"] = lambda p: self._need(
             self.decision, "decision"
         ).set_rib_policy(p["policy"])

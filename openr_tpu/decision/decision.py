@@ -429,6 +429,50 @@ class Decision(OpenrEventBase):
 
         return self.run_in_event_base_thread(_get).result()
 
+    def what_if(
+        self,
+        scenarios: list[list[tuple[str, str]]],
+        area: str = "0",
+        sources: Optional[list[str]] = None,
+    ) -> list[dict]:
+        """Batched SRLG what-if failure analysis (operator surface over
+        ops.protection.srlg_what_if; new capability vs the reference)."""
+
+        def _compute() -> list[dict]:
+            from .protection_api import what_if as run
+
+            ls = self.area_link_states.get(area)
+            if ls is None:
+                return []
+            # default the impact view to this router (all-sources at scale
+            # is cubic output and would stall the Decision thread)
+            srcs = sources if sources is not None else [self.my_node_name]
+            return run(ls, scenarios, srcs, csr=self._protection_csr(ls))
+
+        return self.run_in_event_base_thread(_compute).result()
+
+    def _protection_csr(self, ls):
+        """Reuse the device backend's incrementally-maintained CSR mirror
+        when available (spf_solver.DeviceSpfBackend.csr_mirror)."""
+        mirror = getattr(self.spf_solver.spf, "csr_mirror", None)
+        return mirror(ls) if mirror is not None else None
+
+    def get_ti_lfa(self, node: str = "", area: str = "0") -> dict:
+        """Per-adjacency TI-LFA backup analysis (operator surface over
+        ops.protection.ti_lfa_backups; new capability vs the reference)."""
+
+        def _compute() -> dict:
+            from .protection_api import ti_lfa as run
+
+            ls = self.area_link_states.get(area)
+            if ls is None:
+                return {"node": node or self.my_node_name, "error": "no area"}
+            return run(
+                ls, node or self.my_node_name, csr=self._protection_csr(ls)
+            )
+
+        return self.run_in_event_base_thread(_compute).result()
+
     def get_received_routes(self, **filters) -> list:
         return self.run_in_event_base_thread(
             lambda: self.prefix_state.get_received_routes_filtered(**filters)

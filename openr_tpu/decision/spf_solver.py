@@ -142,6 +142,11 @@ class DeviceSpfBackend:
             csr.refresh(link_state)
         return csr
 
+    def csr_mirror(self, link_state: LinkState):
+        """Public access to the incrementally-maintained CSR mirror (used
+        by the protection operator surface to avoid per-RPC rebuilds)."""
+        return self._mirror(link_state)
+
     def _result_cache(self, link_state: LinkState) -> dict[str, SpfResult]:
         cached = self._results.get(link_state)
         if cached is None or cached[0] != link_state.version:

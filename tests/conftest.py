@@ -8,12 +8,23 @@ imports anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force, don't setdefault: the driver environment exports
+# JAX_PLATFORMS=axon (the real-TPU tunnel), which would silently route the
+# whole suite through shared TPU hardware — flaky and orders slower
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+# The TPU-tunnel sitecustomize registers its backend at interpreter start
+# and force-updates jax_platforms to "axon,cpu", overriding the env var —
+# so backends() would still dial the (shared, sometimes unavailable)
+# tunnel.  Re-assert cpu at the config layer too.
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

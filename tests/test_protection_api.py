@@ -1,0 +1,102 @@
+"""Operator surface over the protection kernels (decision/protection_api):
+name-level SRLG what-if and TI-LFA reports, plus the ctrl/breeze plumbing.
+Semantics checked on hand-analyzable topologies."""
+
+from __future__ import annotations
+
+from openr_tpu.decision.link_state import LinkState
+from openr_tpu.decision.protection_api import ti_lfa, what_if
+from openr_tpu.utils.topo import grid_topology, ring_topology
+
+
+def build_ls(dbs) -> LinkState:
+    ls = LinkState()
+    for db in dbs:
+        ls.update_adjacency_database(db)
+    return ls
+
+
+class TestWhatIf:
+    def test_ring_single_link_degrades_but_keeps_reachability(self):
+        # 4-ring: failing one link degrades pairs (longer way around) but
+        # disconnects nothing
+        ls = build_ls(ring_topology(4))
+        nodes = sorted(ls.node_names)
+        a, b = nodes[0], nodes[1]
+        rows = what_if(ls, [[(a, b)]])
+        assert len(rows) == 1
+        assert rows[0]["newly_unreachable_pairs"] == 0
+        assert rows[0]["degraded_pairs"] > 0
+        assert rows[0]["links"] == [[a, b]]
+        assert rows[0]["unknown_links"] == []
+
+    def test_srlg_cut_disconnects(self):
+        # failing BOTH links of a 4-ring node cuts it off: 2*(n-1) pairs
+        # (3 sources can't reach it, it can't reach 3)
+        ls = build_ls(ring_topology(4))
+        # ring nodes are r0..r3; r0 connects to r1 and r3
+        rows = what_if(
+            ls, [[("r0", "r1"), ("r0", "r3")]]
+        )
+        assert rows[0]["newly_unreachable_pairs"] == 6
+        assert rows[0]["unknown_links"] == []
+
+    def test_multiple_scenarios_and_unknown_link(self):
+        ls = build_ls(ring_topology(4))
+        rows = what_if(
+            ls,
+            [
+                [("r0", "r1")],
+                [("r0", "nope")],
+            ],
+        )
+        assert len(rows) == 2
+        assert rows[0]["degraded_pairs"] > 0
+        # unknown link -> no-op scenario
+        assert rows[1]["unknown_links"] == [["r0", "nope"]]
+        assert rows[1]["newly_unreachable_pairs"] == 0
+        assert rows[1]["degraded_pairs"] == 0
+
+    def test_sources_filter(self):
+        ls = build_ls(ring_topology(4))
+        all_rows = what_if(ls, [[("r0", "r1")]])
+        one_rows = what_if(
+            ls, [[("r0", "r1")]], sources=["r0"]
+        )
+        assert (
+            0
+            < one_rows[0]["degraded_pairs"]
+            < all_rows[0]["degraded_pairs"]
+        )
+
+
+class TestTiLfa:
+    def test_ring_backups_go_the_other_way(self):
+        ls = build_ls(ring_topology(4))
+        report = ti_lfa(ls, "r0")
+        assert report["node"] == "r0"
+        adjs = {a["neighbor"]: a for a in report["adjacencies"]}
+        assert set(adjs) == {"r1", "r3"}
+        # with (r0,r1) failed, every destination is reached via r3
+        via1 = adjs["r1"]
+        assert via1["unprotected_destinations"] == []
+        assert via1["protected_destinations"] == 3
+        assert via1["backup_first_hops"]["r1"] == ["r3"]
+        assert via1["backup_first_hops"]["r2"] == ["r3"]
+
+    def test_grid_corner_has_two_adjacencies(self):
+        ls = build_ls(grid_topology(3))
+        report = ti_lfa(ls, "node-0-0")
+        assert len(report["adjacencies"]) == 2
+        for adj in report["adjacencies"]:
+            # 3x3 grid survives any single link failure
+            assert adj["unprotected_destinations"] == []
+            assert adj["protected_destinations"] == 8
+            # backup first hop avoids the failed neighbor for the
+            # destination directly behind the failed link
+            failed = adj["neighbor"]
+            assert failed not in adj["backup_first_hops"][failed]
+
+    def test_unknown_node(self):
+        ls = build_ls(ring_topology(3))
+        assert "error" in ti_lfa(ls, "nope")

@@ -298,6 +298,15 @@ class TestTcpSystem:
         out = self.breeze(ports[0], "prefixmgr", "view")
         out = self.breeze(ports[1], "prefixmgr", "view")
         assert "fc01::/64" in out
+        # failure-protection analysis (SRLG what-if + TI-LFA surfaces).
+        # Impact defaults to this router's view: tcp-0 loses tcp-1 (1 pair)
+        out = self.breeze(ports[0], "decision", "what-if", "tcp-0/tcp-1")
+        assert "tcp-0/tcp-1" in out
+        row = out.splitlines()[2]
+        assert row.split()[2] == "1", out
+        out = self.breeze(ports[0], "decision", "tilfa", "tcp-0", "-v")
+        assert "node: tcp-0" in out
+        assert "tcp-1" in out  # the (unprotectable) adjacency is listed
 
         # drain via CLI and observe the overload bit propagate
         self.breeze(ports[0], "lm", "set-node-overload")
