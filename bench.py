@@ -353,6 +353,65 @@ def bench_reconvergence_grid1024() -> dict:
     }
 
 
+def bench_ksp2_grid1024() -> dict:
+    """KSP2_ED_ECMP route build on a 1k grid (reference:
+    BM_DecisionGridAdjUpdates KSP2 rows, DecisionBenchmark.cpp:48-54):
+    32 KSP2 prefixes, k=1/k=2 edge-disjoint paths for every best node —
+    host per-destination recursion vs ONE masked batched device run."""
+    from openr_tpu.decision import LinkState
+    from openr_tpu.decision.prefix_state import PrefixState
+    from openr_tpu.decision.spf_solver import DeviceSpfBackend, SpfSolver
+    from openr_tpu.types import (
+        PrefixEntry,
+        PrefixForwardingAlgorithm,
+        PrefixForwardingType,
+    )
+    from openr_tpu.utils.topo import grid_topology
+
+    dbs = grid_topology(32)
+
+    def fresh_state():
+        ls = LinkState()
+        for db in dbs:
+            ls.update_adjacency_database(db)
+        ps = PrefixState()
+        for i in range(0, 1024, 32):  # 32 KSP2 prefixes
+            ps.update_prefix(
+                dbs[i].this_node_name,
+                "0",
+                PrefixEntry(
+                    prefix=f"fc00:{i:x}::/64",
+                    forwarding_type=PrefixForwardingType.SR_MPLS,
+                    forwarding_algorithm=PrefixForwardingAlgorithm.KSP2_ED_ECMP,
+                ),
+            )
+        return ls, ps
+
+    def ms(backend, reps=4):
+        out = []
+        rdb = None
+        for _ in range(reps):
+            ls, ps = fresh_state()  # cold caches each rep (the honest cost)
+            solver = SpfSolver("node-0-0", spf_backend=backend)
+            t0 = time.perf_counter()
+            rdb = solver.build_route_db({"0": ls}, ps)
+            out.append((time.perf_counter() - t0) * 1e3)
+        return out, rdb
+
+    host_times, host_rdb = ms(None)
+    device_times, device_rdb = ms(DeviceSpfBackend(min_device_nodes=64))
+    assert host_rdb.unicast_routes == device_rdb.unicast_routes
+    return {
+        "topology": "grid1024",
+        "ksp2_prefixes": 32,
+        "host_ms_min": round(min(host_times), 3),
+        "host_ms_all": [round(t, 2) for t in host_times],
+        "device_ms_min": round(min(device_times), 3),
+        "device_ms_all": [round(t, 2) for t in device_times],
+        "device_vs_host": round(min(host_times) / min(device_times), 2),
+    }
+
+
 def main() -> None:
     from benchmarks import synthetic
 
@@ -360,6 +419,9 @@ def main() -> None:
 
     # --- end-to-end reconvergence after adjacency flap ------------------
     details["rows"]["reconverge_flap_grid1024"] = bench_reconvergence_grid1024()
+
+    # --- KSP2 route build (k-shortest edge-disjoint) --------------------
+    details["rows"]["ksp2_grid1024"] = bench_ksp2_grid1024()
 
     # --- config #1: 1k grid, all sources --------------------------------
     grid = synthetic.grid(32)

@@ -199,7 +199,7 @@ def cmd_decision_tilfa(client: CtrlClient, args) -> None:
             [
                 adj["neighbor"],
                 adj["protected_destinations"],
-                len(adj["unprotected_destinations"]),
+                adj["unprotected_count"],
             ]
         )
     _table(rows, ["Failed adjacency", "Protected dests", "Unprotected dests"])

@@ -308,11 +308,12 @@ class CsrTopology:
             ]
             for i in reachable:
                 result[self.node_names[i]] = NodeSpfResult(int(d[i]))
-            # path links from DAG edges
+            # path links from DAG edges, in host-Dijkstra append order
             for e in np.nonzero(mask[: self.n_edges])[0]:
                 link, from_name = self.edge_links[e]
                 v = self.node_names[int(self.edge_dst[e])]
                 result[v].path_links.append((link, from_name))
+            self._host_order_path_links(result)
             src_id = self.node_id[src_name]
             if nh_words is not None:
                 slot_names = self._slot_neighbors(links_of, src_name)
@@ -350,6 +351,45 @@ class CsrTopology:
                         else:
                             res.next_hops |= result[prev].next_hops
             out[src_name] = result
+        return out
+
+    @staticmethod
+    def _host_order_path_links(result: SpfResult) -> None:
+        """Order each node's path_links exactly as the host Dijkstra
+        appends them — by (dist(prev), prev_name, link): run_spf pops the
+        heap by (metric, node name) and iterates each node's links sorted
+        (link_state.py run_spf).  trace_one_path's greedy link consumption
+        is order-sensitive, so KSP parity with the host needs this."""
+        for res in result.values():
+            res.path_links.sort(
+                key=lambda lp: (result[lp[1]].metric, lp[1], lp[0])
+            )
+
+    def row_path_links(self, dist_row: np.ndarray, dag_row: np.ndarray) -> SpfResult:
+        """One kernel row -> SpfResult with metric + path_links only (no
+        first-hop sets) — the shape `trace_one_path` walks for KSP path
+        extraction."""
+        from ..ops.sssp import INF32
+
+        inf = int(INF32)
+        result: SpfResult = {}
+        for i in range(self.n_nodes):
+            if dist_row[i] < inf:
+                result[self.node_names[i]] = NodeSpfResult(int(dist_row[i]))
+        for e in np.nonzero(dag_row[: self.n_edges])[0]:
+            link, from_name = self.edge_links[e]
+            v = self.node_names[int(self.edge_dst[e])]
+            result[v].path_links.append((link, from_name))
+        self._host_order_path_links(result)
+        return result
+
+    def edges_of_links(self) -> dict:
+        """Link -> [directed edge ids] (both directions; parallel links map
+        to their own instances)."""
+        out: dict = {}
+        for e in range(self.n_edges):
+            link, _ = self.edge_links[e]
+            out.setdefault(link, []).append(e)
         return out
 
     def spf_from(

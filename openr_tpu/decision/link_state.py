@@ -305,6 +305,29 @@ SpfResult = dict[str, NodeSpfResult]
 Path = list[Link]
 
 
+def trace_one_path(
+    src: str,
+    dest: str,
+    result: SpfResult,
+    links_to_ignore: set[Link],
+) -> Optional[Path]:
+    """Extract one not-yet-visited shortest path from an SpfResult's
+    path_links DAG, consuming its links (reference: LinkState::traceOnePath,
+    LinkState.cpp:399-418).  Works on any SpfResult — host Dijkstra or
+    device-kernel reconstruction."""
+    if src == dest:
+        return []
+    for link, prev_node in result[dest].path_links:
+        if link in links_to_ignore:
+            continue
+        links_to_ignore.add(link)
+        path = trace_one_path(src, prev_node, result, links_to_ignore)
+        if path is not None:
+            path.append(link)
+            return path
+    return None
+
+
 def path_a_in_path_b(a: Path, b: Path) -> bool:
     """True if path A appears contiguously inside path B
     (reference: LinkState::pathAInPathB, LinkState.h:396)."""
@@ -606,17 +629,7 @@ class LinkState:
         result: SpfResult,
         links_to_ignore: set[Link],
     ) -> Optional[Path]:
-        if src == dest:
-            return []
-        for link, prev_node in result[dest].path_links:
-            if link in links_to_ignore:
-                continue
-            links_to_ignore.add(link)
-            path = self._trace_one_path(src, prev_node, result, links_to_ignore)
-            if path is not None:
-                path.append(link)
-                return path
-        return None
+        return trace_one_path(src, dest, result, links_to_ignore)
 
     def get_kth_paths(self, src: str, dest: str, k: int) -> list[Path]:
         assert k >= 1

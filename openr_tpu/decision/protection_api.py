@@ -29,13 +29,13 @@ from .link_state import LinkState
 _WHAT_IF_MAX_ELEMENTS = 1 << 28  # 1 GiB of int32
 
 
-def _link_edge_ids(csr: CsrTopology, a: str, b: str) -> list[int]:
-    """Directed edge ids of every parallel link between nodes a and b
-    (both directions — failing a link kills both)."""
-    out = []
-    for e, (link, from_node) in enumerate(csr.edge_links):
-        if {link.n1, link.n2} == {a, b}:
-            out.append(e)
+def _pair_edge_ids(csr: CsrTopology) -> dict[tuple[str, str], list[int]]:
+    """(sorted node pair) -> directed edge ids of every parallel link
+    between them — one O(E) pass, O(1) per scenario-link lookup."""
+    out: dict[tuple[str, str], list[int]] = {}
+    for e, (link, _from) in enumerate(csr.edge_links):
+        key = (link.n1, link.n2) if link.n1 <= link.n2 else (link.n2, link.n1)
+        out.setdefault(key, []).append(e)
     return out
 
 
@@ -78,13 +78,15 @@ def what_if(
     )
 
     # row 0 = no-failure baseline, rows 1.. = scenarios: one device call
+    pair_ids = _pair_edge_ids(csr)
     masks = np.ones((len(scenarios) + 1, csr.edge_capacity), dtype=bool)
     resolved: list[dict] = []
     for f, links in enumerate(scenarios):
         known: list[list[str]] = []
         unknown: list[list[str]] = []
         for a, b in links:
-            ids = _link_edge_ids(csr, a, b)
+            key = (a, b) if a <= b else (b, a)
+            ids = pair_ids.get(key)
             if ids:
                 masks[f + 1, ids] = False
                 known.append([a, b])
@@ -118,7 +120,10 @@ def what_if(
 
 
 def ti_lfa(
-    link_state: LinkState, node: str, csr: Optional[CsrTopology] = None
+    link_state: LinkState,
+    node: str,
+    csr: Optional[CsrTopology] = None,
+    max_report_destinations: int = 1000,
 ) -> dict:
     """Per-out-adjacency backup analysis for `node`.
 
@@ -126,7 +131,12 @@ def ti_lfa(
     with that edge (and its reverse) failed, and reports per-destination
     backup first hops — the loop-free alternates TI-LFA encodes as repair
     segments.  Destinations unreachable even BEFORE the failure are
-    excluded (they are a topology problem, not a protection gap)."""
+    excluded (they are a topology problem, not a protection gap).
+
+    Counts always cover every destination; the per-destination
+    backup/unprotected LISTS are truncated to `max_report_destinations`
+    per adjacency (this runs on the Decision event thread and returns
+    over the ctrl wire — an unbounded 100k-node report would stall both)."""
     from ..ops import protection as prot
 
     if csr is None:
@@ -149,38 +159,30 @@ def ti_lfa(
     rev_full = np.full(csr.edge_capacity, -1, dtype=np.int32)
     rev_full[: csr.n_edges] = np.asarray(rev)
 
+    # final row -1: nothing failed -> the pre-failure baseline, from the
+    # same batched call (ti_lfa_backups masks nothing for ids < 0)
     dist, dag = prot.ti_lfa_backups(
         np.int32(src_id),
-        np.asarray(out_edges, dtype=np.int32),
+        np.asarray(out_edges + [-1], dtype=np.int32),
         csr.edge_src,
         csr.edge_dst,
         csr.edge_metric,
         csr.edge_up,
         csr.node_overloaded,
         rev_full,
-        max_degree=len(out_edges),
+        max_degree=len(out_edges) + 1,
     )
-    dist = np.asarray(dist)  # [D, N_cap]
-    dag = np.asarray(dag)  # [D, E_cap]
-
-    # pre-failure baseline: one more batched row with nothing failed
-    baseline = np.asarray(
-        prot.srlg_what_if(
-            np.asarray([src_id], dtype=np.int32),
-            csr.edge_src,
-            csr.edge_dst,
-            csr.edge_metric,
-            csr.edge_up,
-            csr.node_overloaded,
-            np.ones((1, csr.edge_capacity), dtype=bool),
-        )
-    )[0, 0]
+    dist = np.asarray(dist)  # [D+1, N_cap]
+    dag = np.asarray(dag)  # [D+1, E_cap]
+    baseline = dist[-1]
 
     adjacencies = []
     for d, e_failed in enumerate(out_edges):
         failed_nbr = csr.node_names[int(csr.edge_dst[e_failed])]
         backups = _first_hops_from_dag(csr, src_id, dist[d], dag[d])
         reachable = 0
+        lost = 0
+        truncated = False
         unprotected: list[str] = []
         backup_map: dict[str, list[str]] = {}
         for v_name in csr.node_names:
@@ -189,15 +191,24 @@ def ti_lfa(
                 continue  # self, or already unreachable pre-failure
             if dist[d, v] < INF32:
                 reachable += 1
-                backup_map[v_name] = sorted(backups.get(v, ()))
+                if len(backup_map) < max_report_destinations:
+                    backup_map[v_name] = sorted(backups.get(v, ()))
+                else:
+                    truncated = True
             else:
-                unprotected.append(v_name)
+                lost += 1
+                if len(unprotected) < max_report_destinations:
+                    unprotected.append(v_name)
+                else:
+                    truncated = True
         adjacencies.append(
             {
                 "neighbor": failed_nbr,
                 "protected_destinations": reachable,
+                "unprotected_count": lost,
                 "unprotected_destinations": unprotected,
                 "backup_first_hops": backup_map,
+                "truncated": truncated,
             }
         )
     return {"node": node, "adjacencies": adjacencies}

@@ -21,6 +21,8 @@ import ipaddress
 import weakref
 from typing import Optional, Protocol
 
+import numpy as np
+
 from ..types import (
     MplsAction,
     MplsActionCode,
@@ -93,12 +95,21 @@ class SpfBackend(Protocol):
 
     def get_spf_result(self, link_state: LinkState, src: str) -> SpfResult: ...
 
+    def get_kth_paths(
+        self, link_state: LinkState, src: str, dest: str, k: int
+    ) -> list: ...
+
 
 class HostSpfBackend:
     """Memoized host Dijkstra (the reference's exact behavior)."""
 
     def get_spf_result(self, link_state: LinkState, src: str) -> SpfResult:
         return link_state.get_spf_result(src)
+
+    def get_kth_paths(
+        self, link_state: LinkState, src: str, dest: str, k: int
+    ) -> list:
+        return link_state.get_kth_paths(src, dest, k)
 
 
 class DeviceSpfBackend:
@@ -128,6 +139,10 @@ class DeviceSpfBackend:
             weakref.WeakKeyDictionary()
         )
         self._results: "weakref.WeakKeyDictionary[LinkState, tuple[int, dict[str, SpfResult]]]" = (
+            weakref.WeakKeyDictionary()
+        )
+        # (src, dest, k) -> list[Path], version-guarded like _results
+        self._kth_results: "weakref.WeakKeyDictionary[LinkState, tuple[int, dict]]" = (
             weakref.WeakKeyDictionary()
         )
 
@@ -181,6 +196,96 @@ class DeviceSpfBackend:
         csr = self._mirror(link_state)
         cache.update(csr.spf_from([src]))
         return cache[src]
+
+    # -- batched k-shortest edge-disjoint paths -----------------------------
+
+    def _kth_cache(self, link_state: LinkState) -> dict:
+        cached = self._kth_results.get(link_state)
+        if cached is None or cached[0] != link_state.version:
+            cached = (link_state.version, {})
+            self._kth_results[link_state] = cached
+        return cached[1]
+
+    def get_kth_paths(
+        self, link_state: LinkState, src: str, dest: str, k: int
+    ) -> list:
+        if link_state.num_nodes() < self.min_device_nodes:
+            return link_state.get_kth_paths(src, dest, k)
+        cache = self._kth_cache(link_state)
+        hit = cache.get((src, dest, k))
+        if hit is not None:
+            return hit
+        # single miss: batch of one (the solver prefetches the full
+        # destination set ahead of per-prefix queries)
+        self.prefetch_kth_paths(link_state, src, [dest])
+        res = cache.get((src, dest, k))
+        # [] is a valid answer (unreachable dest), not a miss
+        return res if res is not None else link_state.get_kth_paths(
+            src, dest, k
+        )
+
+    def prefetch_kth_paths(
+        self, link_state: LinkState, src: str, dests: list[str]
+    ) -> None:
+        """k=1 and k=2 edge-disjoint paths for many destinations in ONE
+        masked device run.
+
+        The reference recurses per destination — k=2 is a fresh
+        LinkState::runSpf with that destination's first-path links excluded
+        (LinkState.cpp:763-793).  The exclusion sets differ per
+        destination, which is exactly the kernel's per-row mask axis
+        (ops.sssp.spf_forward_ell_masked): row d = SPF from src with
+        dest-d's first-path links down."""
+        from .link_state import trace_one_path
+
+        if link_state.num_nodes() < self.min_device_nodes:
+            return
+        csr = self._mirror(link_state)
+        if src not in csr.node_id:
+            return  # unknown/linkless source: host fallback serves it
+        cache = self._kth_cache(link_state)
+        base = self.get_spf_result(link_state, src)
+
+        # k=1: trace from the (cached, device-computed) base SP-DAG
+        need_second: list[tuple[str, set]] = []
+        for dest in dests:
+            if (src, dest, 1) not in cache:
+                paths = []
+                if dest in base:
+                    visited: set = set()
+                    # empty path (src == dest) is falsy and not collected,
+                    # matching LinkState.get_kth_paths
+                    while p := trace_one_path(src, dest, base, visited):
+                        paths.append(p)
+                cache[(src, dest, 1)] = paths
+            if (src, dest, 2) not in cache:
+                ignore = {
+                    link for path in cache[(src, dest, 1)] for link in path
+                }
+                if ignore:
+                    need_second.append((dest, ignore))
+                else:
+                    cache[(src, dest, 2)] = []
+
+        if not need_second:
+            return
+        link_edges = csr.edges_of_links()
+        mask = np.ones((len(need_second), csr.edge_capacity), dtype=bool)
+        for row, (_dest, ignore) in enumerate(need_second):
+            for link in ignore:
+                for e in link_edges.get(link, ()):
+                    mask[row, e] = False
+        dist, dag = csr.run_batched_spf(
+            [src] * len(need_second), extra_edge_mask=mask
+        )
+        for row, (dest, _ignore) in enumerate(need_second):
+            res = csr.row_path_links(dist[row], dag[row])
+            paths = []
+            if dest in res:
+                visited = set()
+                while p := trace_one_path(src, dest, res, visited):
+                    paths.append(p)
+            cache[(src, dest, 2)] = paths
 
 
 class SpfSolver:
@@ -481,11 +586,22 @@ class SpfSolver:
         paths: list[tuple[str, Path]] = []  # (area, path)
 
         for area, link_state in area_link_states.items():
+            # batched device prefetch of k=1/k=2 for every best node (one
+            # masked kernel run instead of per-destination host recursion)
+            prefetch = getattr(self.spf, "prefetch_kth_paths", None)
+            if prefetch is not None:
+                prefetch(
+                    link_state,
+                    self.my_node_name,
+                    sorted({node for node, _ in best.all_node_areas}),
+                )
             # shortest paths first
             for node, best_area in sorted(best.all_node_areas):
                 if node == self.my_node_name and best_area == area:
                     continue
-                for path in link_state.get_kth_paths(self.my_node_name, node, 1):
+                for path in self.spf.get_kth_paths(
+                    link_state, self.my_node_name, node, 1
+                ):
                     paths.append((area, path))
             # second shortest, skipping those containing a first path
             # (anti double-spray, Decision.cpp:1006-1037)
@@ -493,8 +609,8 @@ class SpfSolver:
             for node, best_area in sorted(best.all_node_areas):
                 if area != best_area:
                     continue
-                for sec_path in link_state.get_kth_paths(
-                    self.my_node_name, node, 2
+                for sec_path in self.spf.get_kth_paths(
+                    link_state, self.my_node_name, node, 2
                 ):
                     from .link_state import path_a_in_path_b
 
