@@ -248,6 +248,63 @@ def cmd_fib_routes(client: CtrlClient, args) -> None:
         print(f"label {route.top_label} nexthops {len(route.next_hops)}")
 
 
+def cmd_fib_validate(client: CtrlClient, args) -> None:
+    """Audit daemon FIB state against the platform agent's programmed
+    table (reference: breeze fib validate against the FibService agent)."""
+    from ..platform import TcpFibAgent
+
+    # programmedOnly: do_not_install prefixes and (with segment routing
+    # off) label routes are tracked by Fib but never sent to the agent
+    db = client.call("getRouteDbFib", programmedOnly=True)
+    daemon_unicast = {r.dest: r for r in db["unicastRoutes"]}
+    daemon_mpls = {r.top_label: r for r in db["mplsRoutes"]}
+
+    agent = TcpFibAgent(host=args.agent_host, port=args.agent_port)
+    try:
+        agent_unicast = {
+            r.dest: r for r in agent.get_route_table_by_client(args.client_id)
+        }
+        agent_mpls = {
+            r.top_label: r
+            for r in agent.get_mpls_route_table_by_client(args.client_id)
+        }
+    except OSError as e:
+        print(
+            f"cannot reach fib agent at "
+            f"[{args.agent_host}]:{args.agent_port}: {e}"
+        )
+        raise SystemExit(1)
+    finally:
+        agent.close()
+
+    ok = True
+    for label, daemon_table, agent_table in (
+        ("unicast", daemon_unicast, agent_unicast),
+        ("mpls", daemon_mpls, agent_mpls),
+    ):
+        missing = sorted(set(daemon_table) - set(agent_table))
+        extra = sorted(set(agent_table) - set(daemon_table))
+        mismatched = sorted(
+            k
+            for k in set(daemon_table) & set(agent_table)
+            if set(daemon_table[k].next_hops) != set(agent_table[k].next_hops)
+        )
+        for kind, keys in (
+            ("missing from agent", missing),
+            ("extra in agent", extra),
+            ("nexthop mismatch", mismatched),
+        ):
+            for key in keys:
+                ok = False
+                print(f"FAIL [{label}] {kind}: {key}")
+        print(
+            f"{label}: daemon={len(daemon_table)} agent={len(agent_table)}"
+        )
+    print("PASS" if ok else "FAIL")
+    if not ok:
+        raise SystemExit(1)
+
+
 def cmd_fib_perf(client: CtrlClient, args) -> None:
     for perf in client.call("getPerfDb"):
         print(f"== convergence {perf.total_duration_ms()}ms ==")
@@ -428,6 +485,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(fn=cmd_decision_tilfa)
 
     fib = sub.add_parser("fib").add_subparsers(dest="cmd", required=True)
+    p = fib.add_parser("validate")
+    p.add_argument("--agent-host", default="::1")
+    p.add_argument("--agent-port", type=int, default=60100)
+    p.add_argument("--client-id", type=int, default=786)
+    p.set_defaults(fn=cmd_fib_validate)
     p = fib.add_parser("routes")
     p.set_defaults(fn=cmd_fib_routes)
     p = fib.add_parser("perf")

@@ -116,6 +116,10 @@ class OpenrConfig:
     eor_time_s: Optional[float] = None
     node_label: int = 0
     persistent_config_store_path: str = ""
+    # standalone FibService platform agent endpoint (reference: fib_port
+    # gflag, Flags.cpp; 0 == use the in-process mock agent)
+    fib_agent_host: str = "::1"
+    fib_agent_port: int = 0
     kvstore_config: KvStoreConf = field(default_factory=KvStoreConf)
     link_monitor_config: LinkMonitorConf = field(default_factory=LinkMonitorConf)
     decision_config: DecisionConf = field(default_factory=DecisionConf)

@@ -294,7 +294,9 @@ class OpenrCtrlHandler:
 
     def _fib_route_db(self, p: dict) -> dict:
         fib = self._need(self.fib, "fib")
-        unicast, mpls = fib.get_route_db()
+        unicast, mpls = fib.get_route_db(
+            programmed_only=bool(p.get("programmedOnly"))
+        )
         return {"unicastRoutes": unicast, "mplsRoutes": mpls}
 
     def _spark_neighbors(self, p: dict) -> list[dict]:

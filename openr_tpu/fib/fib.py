@@ -407,13 +407,28 @@ class Fib(OpenrEventBase):
 
     # -- introspection (reference: getRouteDb/getPerfDb) ---------------------
 
-    def get_route_db(self) -> tuple[list[UnicastRoute], list[MplsRoute]]:
-        return self.run_in_event_base_thread(
-            lambda: (
-                list(self.route_state.unicast_routes.values()),
-                list(self.route_state.mpls_routes.values()),
+    def get_route_db(
+        self, programmed_only: bool = False
+    ) -> tuple[list[UnicastRoute], list[MplsRoute]]:
+        """Tracked route state; with `programmed_only`, restricted to what
+        is actually sent to the agent (do_not_install prefixes are tracked
+        but never programmed, fib.py _update_routes/_sync_fib; MPLS
+        programming is gated on enable_segment_routing)."""
+
+        def _get():
+            unicast = [
+                r
+                for p, r in self.route_state.unicast_routes.items()
+                if not programmed_only or p not in self._do_not_install
+            ]
+            mpls = (
+                []
+                if programmed_only and not self.enable_segment_routing
+                else list(self.route_state.mpls_routes.values())
             )
-        ).result()
+            return unicast, mpls
+
+        return self.run_in_event_base_thread(_get).result()
 
     def get_unicast_routes(self, prefixes: Optional[list[str]] = None) -> list[UnicastRoute]:
         def _get() -> list[UnicastRoute]:

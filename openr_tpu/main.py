@@ -194,6 +194,12 @@ class OpenrDaemon:
         )
 
         # -- fib (reference: Main.cpp:533-545) -------------------------------
+        if fib_agent is None and config.fib_agent_port:
+            from .platform import TcpFibAgent
+
+            fib_agent = TcpFibAgent(
+                host=config.fib_agent_host, port=config.fib_agent_port
+            )
         self.fib_agent = fib_agent or MockFibAgent()
         self.fib = Fib(
             name,
@@ -322,6 +328,9 @@ class OpenrDaemon:
         for module in modules:
             if module is not None:
                 module.wait_until_stopped(5)
+        close_agent = getattr(self.fib_agent, "close", None)
+        if callable(close_agent):
+            close_agent()  # TcpFibAgent holds a persistent socket
         self.config_store.close()
 
 

@@ -1,0 +1,9 @@
+"""Platform layer: the standalone FibService agent a router's Fib module
+programs routes into (reference: openr/platform/ — NetlinkFibHandler served
+by the `platform_linux` binary, LinuxPlatformMain.cpp)."""
+
+from .fib_agent import (  # noqa: F401
+    FibAgentServer,
+    SimulatedRouteTable,
+    TcpFibAgent,
+)

@@ -1,0 +1,351 @@
+"""Standalone FibService platform agent.
+
+The reference ships `platform_linux` (LinuxPlatformMain.cpp), a separate
+process whose NetlinkFibHandler (openr/platform/NetlinkFibHandler.h)
+implements the thrift FibService (openr/if/Platform.thrift:71-160) and
+programs the Linux kernel via netlink.  The TPU-native equivalent keeps
+the same process boundary and API surface but programs a simulated route
+table (this image has no netlink/kernel surface): the daemon's Fib module
+talks to it over the NDJSON-RPC wire transport, and `breeze fib validate`
+audits daemon state against the agent's table.
+
+Run standalone:  python -m openr_tpu.platform.fib_agent --port 60100
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import socket
+import threading
+import time
+from typing import Any, Iterable, Optional
+
+from ..serializer import from_wire, to_wire
+from ..types import MplsRoute, UnicastRoute
+
+log = logging.getLogger(__name__)
+
+
+class SimulatedRouteTable:
+    """The agent-side route store (reference: NetlinkFibHandler's kernel
+    programming + per-client route tracking; simulated kernel).
+
+    Thread-safe: the server may run handlers from multiple connections."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._alive_since = int(time.time())
+        self.unicast: dict[int, dict[str, UnicastRoute]] = {}
+        self.mpls: dict[int, dict[int, MplsRoute]] = {}
+        self.counters: dict[str, int] = {}
+
+    def _bump(self, counter: str, n: int = 1) -> None:
+        self.counters[counter] = self.counters.get(counter, 0) + n
+
+    # -- FibService API (Platform.thrift:71-160) -----------------------------
+
+    def add_unicast_routes(
+        self, client_id: int, routes: list[UnicastRoute]
+    ) -> None:
+        with self._lock:
+            table = self.unicast.setdefault(client_id, {})
+            for route in routes:
+                table[route.dest] = route
+            self._bump("fibagent.add_unicast", len(routes))
+
+    def delete_unicast_routes(
+        self, client_id: int, prefixes: list[str]
+    ) -> None:
+        with self._lock:
+            table = self.unicast.setdefault(client_id, {})
+            for prefix in prefixes:
+                table.pop(prefix, None)
+            self._bump("fibagent.del_unicast", len(prefixes))
+
+    def add_mpls_routes(self, client_id: int, routes: list[MplsRoute]) -> None:
+        with self._lock:
+            table = self.mpls.setdefault(client_id, {})
+            for route in routes:
+                table[route.top_label] = route
+            self._bump("fibagent.add_mpls", len(routes))
+
+    def delete_mpls_routes(self, client_id: int, labels: list[int]) -> None:
+        with self._lock:
+            table = self.mpls.setdefault(client_id, {})
+            for label in labels:
+                table.pop(label, None)
+            self._bump("fibagent.del_mpls", len(labels))
+
+    def sync_fib(self, client_id: int, routes: list[UnicastRoute]) -> None:
+        with self._lock:
+            self.unicast[client_id] = {r.dest: r for r in routes}
+            self._bump("fibagent.sync_fib")
+
+    def sync_mpls_fib(self, client_id: int, routes: list[MplsRoute]) -> None:
+        with self._lock:
+            self.mpls[client_id] = {r.top_label: r for r in routes}
+            self._bump("fibagent.sync_mpls_fib")
+
+    def get_route_table_by_client(self, client_id: int) -> list[UnicastRoute]:
+        with self._lock:
+            return sorted(
+                self.unicast.get(client_id, {}).values(),
+                key=lambda r: r.dest,
+            )
+
+    def get_mpls_route_table_by_client(self, client_id: int) -> list[MplsRoute]:
+        with self._lock:
+            return sorted(
+                self.mpls.get(client_id, {}).values(),
+                key=lambda r: r.top_label,
+            )
+
+    def alive_since(self) -> int:
+        return self._alive_since
+
+    def get_counters(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self.counters)
+
+
+class FibAgentServer:
+    """NDJSON-RPC server fronting a SimulatedRouteTable — the process
+    boundary the reference crosses with thrift (Fib -> platform agent)."""
+
+    def __init__(
+        self,
+        table: Optional[SimulatedRouteTable] = None,
+        host: str = "::1",
+        port: int = 0,
+    ) -> None:
+        self.table = table or SimulatedRouteTable()
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+
+    # method table: wire name (Platform.thrift) -> handler
+    def _dispatch(self, method: str, p: dict) -> Any:
+        t = self.table
+        if method == "addUnicastRoutes":
+            return t.add_unicast_routes(p["clientId"], p["routes"])
+        if method == "deleteUnicastRoutes":
+            return t.delete_unicast_routes(p["clientId"], p["prefixes"])
+        if method == "addMplsRoutes":
+            return t.add_mpls_routes(p["clientId"], p["routes"])
+        if method == "deleteMplsRoutes":
+            return t.delete_mpls_routes(p["clientId"], p["topLabels"])
+        if method == "syncFib":
+            return t.sync_fib(p["clientId"], p["routes"])
+        if method == "syncMplsFib":
+            return t.sync_mpls_fib(p["clientId"], p["routes"])
+        if method == "getRouteTableByClient":
+            return t.get_route_table_by_client(p["clientId"])
+        if method == "getMplsRouteTableByClient":
+            return t.get_mpls_route_table_by_client(p["clientId"])
+        if method == "aliveSince":
+            return t.alive_since()
+        if method == "getCounters":
+            return t.get_counters()
+        raise ValueError(f"unknown method {method!r}")
+
+    async def _handle_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                try:
+                    msg = json.loads(line)
+                    result = self._dispatch(
+                        msg.get("method", ""), from_wire(msg.get("params")) or {}
+                    )
+                    reply = {"id": msg.get("id"), "result": to_wire(result)}
+                except Exception as exc:  # surfaced to the client
+                    reply = {
+                        "id": msg.get("id") if isinstance(msg, dict) else None,
+                        "error": f"{type(exc).__name__}: {exc}",
+                    }
+                writer.write(json.dumps(reply).encode() + b"\n")
+                await writer.drain()
+        except (ConnectionResetError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()
+
+    async def _serve(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        self._started.set()
+        async with self._server:
+            await self._server.serve_forever()
+
+    def start(self) -> None:
+        """Serve in a background thread (for in-process tests); the
+        standalone entry point uses run_forever() instead."""
+
+        def _run():
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+            try:
+                self._loop.run_until_complete(self._serve())
+            except asyncio.CancelledError:
+                pass
+            finally:
+                self._loop.close()
+
+        self._thread = threading.Thread(
+            target=_run, name="fib-agent", daemon=True
+        )
+        self._thread.start()
+        assert self._started.wait(10), "fib agent failed to start"
+
+    def stop(self) -> None:
+        if self._loop is not None:
+
+            def _stop():
+                for task in asyncio.all_tasks(self._loop):
+                    task.cancel()
+
+            self._loop.call_soon_threadsafe(_stop)
+        if self._thread is not None:
+            self._thread.join(5)
+
+    def run_forever(self) -> None:
+        asyncio.run(self._serve())
+
+
+class TcpFibAgent:
+    """Client side: implements the Fib module's FibAgent protocol over the
+    agent's wire transport (reference: Fib::createFibClient, Fib.h:68).
+
+    Synchronous (called from the Fib event-base thread); one persistent
+    connection, reconnected on failure — a failed call raises, which drives
+    Fib's retry/backoff + full-resync machinery exactly like a thrift
+    transport error does in the reference."""
+
+    def __init__(self, host: str = "::1", port: int = 60100, timeout_s: float = 5.0):
+        self.host = host
+        self.port = port
+        self.timeout_s = timeout_s
+        self._sock: Optional[socket.socket] = None
+        self._file = None
+        self._next_id = 0
+
+    def _connect(self) -> None:
+        if self._sock is not None:
+            return
+        info = socket.getaddrinfo(
+            self.host, self.port, type=socket.SOCK_STREAM
+        )[0]
+        sock = socket.socket(info[0], info[1])
+        sock.settimeout(self.timeout_s)
+        sock.connect(info[4])
+        self._sock = sock
+        self._file = sock.makefile("rwb")
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+                self._file = None
+
+    def _call(self, method: str, params: dict) -> Any:
+        self._connect()
+        self._next_id += 1
+        request = {
+            "id": self._next_id,
+            "method": method,
+            "params": to_wire(params),
+        }
+        try:
+            self._file.write(json.dumps(request).encode() + b"\n")
+            self._file.flush()
+            line = self._file.readline()
+        except OSError:
+            self.close()
+            raise
+        if not line:
+            self.close()
+            raise ConnectionError("fib agent closed connection")
+        msg = json.loads(line)
+        if "error" in msg:
+            raise RuntimeError(f"fib agent error: {msg['error']}")
+        return from_wire(msg.get("result"))
+
+    # -- FibAgent protocol ---------------------------------------------------
+
+    def add_unicast_routes(
+        self, client_id: int, routes: list[UnicastRoute]
+    ) -> None:
+        self._call("addUnicastRoutes", {"clientId": client_id, "routes": routes})
+
+    def delete_unicast_routes(
+        self, client_id: int, prefixes: list[str]
+    ) -> None:
+        self._call(
+            "deleteUnicastRoutes", {"clientId": client_id, "prefixes": prefixes}
+        )
+
+    def add_mpls_routes(self, client_id: int, routes: list[MplsRoute]) -> None:
+        self._call("addMplsRoutes", {"clientId": client_id, "routes": routes})
+
+    def delete_mpls_routes(self, client_id: int, labels: list[int]) -> None:
+        self._call(
+            "deleteMplsRoutes", {"clientId": client_id, "topLabels": labels}
+        )
+
+    def sync_fib(self, client_id: int, routes: list[UnicastRoute]) -> None:
+        self._call("syncFib", {"clientId": client_id, "routes": routes})
+
+    def sync_mpls_fib(self, client_id: int, routes: list[MplsRoute]) -> None:
+        self._call("syncMplsFib", {"clientId": client_id, "routes": routes})
+
+    def get_route_table_by_client(self, client_id: int) -> list[UnicastRoute]:
+        return self._call("getRouteTableByClient", {"clientId": client_id})
+
+    def get_mpls_route_table_by_client(self, client_id: int) -> list[MplsRoute]:
+        return self._call("getMplsRouteTableByClient", {"clientId": client_id})
+
+    def alive_since(self) -> int:
+        return int(self._call("aliveSince", {}))
+
+    def get_counters(self) -> dict[str, int]:
+        return self._call("getCounters", {})
+
+
+def main(argv: Optional[Iterable[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Standalone FibService platform agent "
+        "(reference: platform_linux / LinuxPlatformMain.cpp)"
+    )
+    parser.add_argument("--host", default="::1")
+    parser.add_argument("--port", type=int, default=60100)
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args(list(argv) if argv is not None else None)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    server = FibAgentServer(host=args.host, port=args.port)
+    print(f"fib-agent listening on [{args.host}]:{args.port}", flush=True)
+    try:
+        server.run_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

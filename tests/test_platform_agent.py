@@ -1,0 +1,212 @@
+"""Standalone FibService platform agent (reference: openr/platform/
+NetlinkFibHandler + LinuxPlatformMain.cpp): wire-level unit tests plus a
+real two-process system test — daemon and agent over real sockets, with
+`breeze fib validate` auditing them and an agent restart driving the
+aliveSince-based full resync (reference: Fib::keepAliveCheck, Fib.h:181)."""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from openr_tpu.cli import breeze
+from openr_tpu.platform import FibAgentServer, SimulatedRouteTable, TcpFibAgent
+from openr_tpu.types import MplsRoute, NextHop, UnicastRoute
+
+CLIENT = 786
+
+
+def free_port() -> int:
+    with socket.socket(socket.AF_INET6, socket.SOCK_STREAM) as s:
+        s.bind(("::1", 0))
+        return s.getsockname()[1]
+
+
+def route(dest: str, *nbrs: str) -> UnicastRoute:
+    return UnicastRoute(
+        dest=dest,
+        next_hops=[
+            NextHop(address="::1", if_name=f"if-{n}", neighbor_node_name=n)
+            for n in nbrs
+        ],
+    )
+
+
+class TestAgentWire:
+    @pytest.fixture
+    def pair(self):
+        server = FibAgentServer()
+        server.start()
+        client = TcpFibAgent(port=server.port)
+        yield server, client
+        client.close()
+        server.stop()
+
+    def test_unicast_roundtrip(self, pair):
+        server, client = pair
+        client.add_unicast_routes(CLIENT, [route("fc00::/64", "a")])
+        client.add_unicast_routes(
+            CLIENT, [route("fc00:1::/64", "a", "b")]
+        )
+        table = client.get_route_table_by_client(CLIENT)
+        assert [r.dest for r in table] == ["fc00:1::/64", "fc00::/64"]
+        assert len(table[0].next_hops) == 2
+
+        client.delete_unicast_routes(CLIENT, ["fc00::/64"])
+        table = client.get_route_table_by_client(CLIENT)
+        assert [r.dest for r in table] == ["fc00:1::/64"]
+
+    def test_sync_replaces_table(self, pair):
+        server, client = pair
+        client.add_unicast_routes(CLIENT, [route("fc00::/64", "a")])
+        client.sync_fib(CLIENT, [route("fc00:2::/64", "b")])
+        table = client.get_route_table_by_client(CLIENT)
+        assert [r.dest for r in table] == ["fc00:2::/64"]
+
+    def test_mpls_roundtrip(self, pair):
+        server, client = pair
+        client.add_mpls_routes(
+            CLIENT,
+            [MplsRoute(top_label=100, next_hops=[NextHop(address="::1")])],
+        )
+        assert [
+            r.top_label for r in client.get_mpls_route_table_by_client(CLIENT)
+        ] == [100]
+        client.delete_mpls_routes(CLIENT, [100])
+        assert client.get_mpls_route_table_by_client(CLIENT) == []
+
+    def test_clients_isolated(self, pair):
+        server, client = pair
+        client.add_unicast_routes(1, [route("fc00::/64", "a")])
+        assert client.get_route_table_by_client(2) == []
+
+    def test_alive_since_and_counters(self, pair):
+        server, client = pair
+        assert client.alive_since() <= int(time.time())
+        client.add_unicast_routes(CLIENT, [route("fc00::/64", "a")])
+        assert client.get_counters()["fibagent.add_unicast"] == 1
+
+    def test_unknown_method_is_error(self, pair):
+        server, client = pair
+        with pytest.raises(RuntimeError, match="unknown method"):
+            client._call("nope", {})
+
+    def test_connection_failure_raises(self):
+        client = TcpFibAgent(port=free_port(), timeout_s=0.5)
+        with pytest.raises(OSError):
+            client.alive_since()
+
+
+class TestTwoProcessSystem:
+    """Daemon + agent as two real processes over real sockets."""
+
+    @pytest.fixture
+    def agent_proc(self):
+        port = free_port()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "openr_tpu.platform.fib_agent",
+             "--port", str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        # wait until it accepts connections
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                TcpFibAgent(port=port, timeout_s=0.5).alive_since()
+                break
+            except OSError:
+                assert proc.poll() is None, proc.stdout.read()
+                time.sleep(0.05)
+        else:
+            pytest.fail("agent did not come up")
+        yield port, proc
+        proc.terminate()
+        proc.wait(5)
+
+    def test_daemon_programs_real_agent_process(self, agent_proc):
+        from openr_tpu.main import OpenrDaemon
+        from openr_tpu.spark import MockIoProvider
+        from openr_tpu.types import LinkEvent, PrefixEntry, PrefixType
+        from tests.test_system import make_config, wait_for
+
+        agent_port, proc = agent_proc
+        spark_fabric = MockIoProvider()
+        ctrl_port = free_port()
+        daemons = []
+        for i, port in enumerate((ctrl_port, free_port())):
+            name = f"pa-{i}"
+            cfg = make_config(name, ctrl_port=port)
+            if i == 0:
+                cfg.fib_agent_port = agent_port  # node 0 uses the real agent
+            d = OpenrDaemon(
+                cfg,
+                io_provider=spark_fabric.endpoint(name),
+                spark_v6_addr="::1",
+            )
+            d.start()
+            daemons.append(d)
+        spark_fabric.connect("pa-0", "veth0", "pa-1", "veth1")
+        daemons[0].netlink_events_queue.push(LinkEvent("veth0", 1, True))
+        daemons[1].netlink_events_queue.push(LinkEvent("veth1", 1, True))
+
+        probe = TcpFibAgent(port=agent_port)
+        try:
+            daemons[1].prefix_manager.advertise_prefixes(
+                PrefixType.LOOPBACK, [PrefixEntry(prefix="fc03::/64")]
+            )
+            assert wait_for(
+                lambda: any(
+                    r.dest == "fc03::/64"
+                    for r in probe.get_route_table_by_client(CLIENT)
+                ),
+                timeout=30,
+            ), "route never reached the agent process"
+
+            # breeze fib validate: daemon vs agent must agree
+            out = io.StringIO()
+            with contextlib.redirect_stdout(out):
+                rc = breeze.main(
+                    ["-p", str(ctrl_port), "fib", "validate",
+                     "--agent-port", str(agent_port)]
+                )
+            assert rc == 0, out.getvalue()
+            assert "PASS" in out.getvalue()
+
+            # agent restart: new process, fresh (empty) table + new
+            # aliveSince -> daemon's keepalive triggers a full resync
+            proc.terminate()
+            proc.wait(5)
+            probe.close()
+            proc2 = subprocess.Popen(
+                [sys.executable, "-m", "openr_tpu.platform.fib_agent",
+                 "--port", str(agent_port)],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+            )
+            def resynced() -> bool:
+                try:
+                    table = TcpFibAgent(
+                        port=agent_port, timeout_s=0.5
+                    ).get_route_table_by_client(CLIENT)
+                except OSError:
+                    return False
+                return any(r.dest == "fc03::/64" for r in table)
+
+            try:
+                assert wait_for(
+                    resynced, timeout=30
+                ), "daemon did not resync after agent restart"
+            finally:
+                proc2.terminate()
+                proc2.wait(5)
+        finally:
+            probe.close()
+            for d in daemons:
+                d.stop()
