@@ -1,0 +1,4 @@
+"""Library-usage examples (reference: /examples — KvStoreAgent.cpp,
+KvStorePoller.cpp, SetRibPolicyExample.cpp) plus a plugin-seam route
+injector. Each is runnable against a live daemon and exercised by
+tests/test_examples.py."""

@@ -1,0 +1,93 @@
+"""KvStoreAgent: consume openr_tpu as a LIBRARY next to a running daemon.
+
+Mirrors /root/reference/examples/KvStoreAgent.cpp:15-45: an application
+module with its own event base that (a) persists a key under its own
+prefix, bumping the value periodically, and (b) subscribes to every key
+under that prefix to observe other nodes' agents.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional
+
+from openr_tpu.kvstore import KvStoreClientInternal
+from openr_tpu.runtime.eventbase import OpenrEventBase
+from openr_tpu.runtime.queue import RQueue
+
+log = logging.getLogger(__name__)
+
+AGENT_KEY_PREFIX = "agentData:"
+
+
+class KvStoreAgent(OpenrEventBase):
+    """Reference: class KvStoreAgent (examples/KvStoreAgent.cpp)."""
+
+    def __init__(
+        self,
+        node_id: str,
+        kvstore,
+        kvstore_updates: RQueue,
+        area: str = "0",
+        change_interval_s: float = 0.2,
+        on_peer_data: Optional[Callable[[str, bytes], None]] = None,
+    ) -> None:
+        super().__init__(name=f"kvstore-agent-{node_id}")
+        self.node_id = node_id
+        self.area = area
+        self.change_interval_s = change_interval_s
+        self.on_peer_data = on_peer_data
+        self.peer_data: dict[str, bytes] = {}
+        self._val = 0
+        self._kvstore = kvstore
+        self._kvstore_updates = kvstore_updates
+        self.client: Optional[KvStoreClientInternal] = None
+        self._timer = None
+
+    def start(self) -> None:
+        self.run()
+        self.wait_until_running()
+        # the client lives on THIS event base (the library pattern: any
+        # OpenrEventBase owner can host a KvStoreClientInternal)
+        self.client = KvStoreClientInternal(
+            self,
+            self.node_id,
+            self._kvstore,
+            self._kvstore_updates,
+        )
+        self.run_in_event_base_thread(self._setup).result()
+
+    def _setup(self) -> None:
+        # watch everyone's agent keys (reference: setKvCallback + prefix
+        # filter, KvStoreAgent.cpp:24-34)
+        self.client.subscribe_key_filter(f"^{AGENT_KEY_PREFIX}", self._on_key)
+        self._tick()
+
+    def _on_key(self, key: str, value) -> None:
+        if value is None or value.value is None:
+            return
+        if value.originator_id == self.node_id:
+            return
+        log.info(
+            "got data from %s: %r", value.originator_id, value.value
+        )
+        self.peer_data[value.originator_id] = value.value
+        if self.on_peer_data is not None:
+            self.on_peer_data(value.originator_id, value.value)
+
+    def _tick(self) -> None:
+        # periodically change our value (reference: periodicValueChanger_,
+        # KvStoreAgent.cpp:37-44); persistKey re-advertises with a version
+        # bump if anyone overwrites us
+        self._val += 1
+        self.client.persist_key(
+            self.area,
+            f"{AGENT_KEY_PREFIX}{self.node_id}",
+            str(self._val).encode(),
+        )
+        self._timer = self.schedule_timeout(self.change_interval_s, self._tick)
+
+    def stop(self) -> None:  # type: ignore[override]
+        if self.client is not None:
+            self.client.stop()
+        super().stop()

@@ -1,0 +1,57 @@
+"""KvStorePoller: poll several nodes' ctrl endpoints and dump their
+KvStore contents side by side (reference: examples/KvStorePoller.cpp —
+fan out getKvStoreKeyValsArea to a set of (addr, port) endpoints).
+
+Run: python -m examples.kvstore_poller host:port [host:port ...]
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Iterable
+
+from openr_tpu.ctrl import CtrlClient
+
+
+def poll(
+    endpoints: Iterable[tuple[str, int]], area: str = "0"
+) -> dict[str, dict[str, object]]:
+    """{endpoint: {key: Value}} for every reachable endpoint; unreachable
+    endpoints map to None (the reference logs and skips them)."""
+    out: dict[str, dict[str, object]] = {}
+    for host, port in endpoints:
+        name = f"[{host}]:{port}"
+        client = CtrlClient(host, port)
+        try:
+            pub = client.call(
+                "getKvStoreKeyValsFilteredArea", area=area, match_all=True
+            )
+            out[name] = dict(pub.key_vals)
+        except (ConnectionError, OSError):
+            out[name] = None
+        finally:
+            client.close()
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    if not args:
+        print("usage: kvstore_poller host:port [host:port ...]")
+        return 2
+    endpoints = []
+    for spec in args:
+        host, _, port = spec.rpartition(":")
+        endpoints.append((host or "::1", int(port)))
+    for name, keys in poll(endpoints).items():
+        if keys is None:
+            print(f"{name}: unreachable")
+            continue
+        print(f"{name}: {len(keys)} keys")
+        for key, val in sorted(keys.items()):
+            print(f"  {key} v={val.version} from={val.originator_id}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,72 @@
+"""Route-injector plugin: the BGP-speaker seam in miniature.
+
+Set `plugin_module: "examples.route_injector_plugin"` in the daemon config
+and this module attaches at the reference's pluginStart point
+(openr/Main.cpp:501-510): it originates a BGP-type prefix through the
+PrefixManager queue and tails every computed route delta, mirroring what
+the closed-source BGP speaker does with the same three queues.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from openr_tpu.runtime.queue import QueueClosedError
+from openr_tpu.types import PrefixEntry, PrefixType, PrefixUpdateRequest
+
+log = logging.getLogger(__name__)
+
+INJECTED_PREFIX = "fc00:b9b:1::/64"
+
+
+class _Injector:
+    def __init__(self, args) -> None:
+        self.args = args
+        self.seen_route_updates = 0
+        self.injected = threading.Event()
+        self._reader = args.route_updates_queue
+        self._thread = threading.Thread(
+            target=self._tail_routes, name="route-injector", daemon=True
+        )
+
+    def start(self) -> None:
+        # originate one BGP-type prefix (reference: plugin pushes
+        # PrefixEvent onto prefixUpdatesQueue)
+        self.args.prefix_updates_queue.push(
+            PrefixUpdateRequest(
+                prefixes_to_add=[PrefixEntry(prefix=INJECTED_PREFIX)],
+                type=PrefixType.BGP,
+            )
+        )
+        self.injected.set()
+        self._thread.start()
+
+    def _tail_routes(self) -> None:
+        # observe every DecisionRouteUpdate (reference: plugin consumes
+        # routeUpdatesQueue reader for BGP re-advertisement)
+        while True:
+            try:
+                update = self._reader.get()
+            except QueueClosedError:
+                return
+            self.seen_route_updates += 1
+            log.debug(
+                "route update: +%d unicast -%d",
+                len(update.unicast_routes_to_update),
+                len(update.unicast_routes_to_delete),
+            )
+
+    def stop(self) -> None:
+        self._thread.join(0.1)
+
+
+def plugin_start(args) -> _Injector:
+    injector = _Injector(args)
+    injector.start()
+    log.info("route injector attached for %s", args.node_name)
+    return injector
+
+
+def plugin_stop(handle: _Injector) -> None:
+    handle.stop()

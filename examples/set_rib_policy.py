@@ -1,0 +1,59 @@
+"""SetRibPolicyExample: install a RibPolicy through the ctrl API
+(reference: examples/SetRibPolicyExample.cpp — build a RibPolicy with a
+prefix-match statement and action weights, send setRibPolicy).
+
+Run: python -m examples.set_rib_policy --port 2018 --prefix fc00::/64
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from openr_tpu.ctrl import CtrlClient
+from openr_tpu.decision.rib_policy import (
+    RibPolicyConfig,
+    RibPolicyStatementConfig,
+    RibRouteActionWeight,
+)
+
+
+def build_policy(
+    prefix: str, ttl_secs: int, default_weight: int = 1
+) -> RibPolicyConfig:
+    return RibPolicyConfig(
+        statements=[
+            RibPolicyStatementConfig(
+                name="example-statement",
+                prefixes=[prefix],
+                set_weight=RibRouteActionWeight(
+                    default_weight=default_weight,
+                    area_to_weight={"0": 2},
+                ),
+            )
+        ],
+        ttl_secs=ttl_secs,
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="::1")
+    parser.add_argument("--port", type=int, default=2018)
+    parser.add_argument("--prefix", required=True)
+    parser.add_argument("--ttl-secs", type=int, default=300)
+    args = parser.parse_args(argv)
+
+    client = CtrlClient(args.host, args.port)
+    try:
+        client.call(
+            "setRibPolicy", policy=build_policy(args.prefix, args.ttl_secs)
+        )
+        print("policy installed:")
+        print(client.call("getRibPolicy"))
+    finally:
+        client.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

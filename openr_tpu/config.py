@@ -120,6 +120,9 @@ class OpenrConfig:
     # gflag, Flags.cpp; 0 == use the in-process mock agent)
     fib_agent_host: str = "::1"
     fib_agent_port: int = 0
+    # import path of a plugin module exposing plugin_start(PluginArgs)
+    # (reference: the BGP-speaker seam, Plugin.h:23-32 + Main.cpp:501-510)
+    plugin_module: str = ""
     kvstore_config: KvStoreConf = field(default_factory=KvStoreConf)
     link_monitor_config: LinkMonitorConf = field(default_factory=LinkMonitorConf)
     decision_config: DecisionConf = field(default_factory=DecisionConf)

@@ -413,9 +413,12 @@ class Fib(OpenrEventBase):
         """Tracked route state; with `programmed_only`, restricted to what
         is actually sent to the agent (do_not_install prefixes are tracked
         but never programmed, fib.py _update_routes/_sync_fib; MPLS
-        programming is gated on enable_segment_routing)."""
+        programming is gated on enable_segment_routing; dryrun programs
+        nothing at all)."""
 
         def _get():
+            if programmed_only and self.dryrun:
+                return [], []
             unicast = [
                 r
                 for p, r in self.route_state.unicast_routes.items()

@@ -216,6 +216,8 @@ class OpenrDaemon:
         self.prefix_manager: Optional[PrefixManager] = None
         self.prefix_allocator: Optional[PrefixAllocator] = None
         self.ctrl_server: Optional[CtrlServer] = None
+        self._plugin = None
+        self._plugin_handle = None
         self._ctrl_port_override = ctrl_port
         self._started = False
 
@@ -262,6 +264,26 @@ class OpenrDaemon:
             )
             self.prefix_allocator.start()
 
+        # plugin (BGP-speaker seam) BEFORE Decision so its origins are in
+        # place for the first SPF (reference: Main.cpp:501-510)
+        if self.config.plugin_module:
+            from .plugin import PluginArgs, load_plugin, plugin_start
+
+            module = load_plugin(self.config.plugin_module)
+            self._plugin_handle = plugin_start(
+                module,
+                PluginArgs(
+                    prefix_updates_queue=self.prefix_updates_queue,
+                    static_routes_update_queue=self.static_routes_queue,
+                    route_updates_queue=self.route_updates_queue.get_reader(),
+                    config=self.config,
+                    node_name=self.config.node_name,
+                ),
+            )
+            # recorded only after a successful start so a plugin_start
+            # failure doesn't make stop() call plugin_stop(module, None)
+            self._plugin = module
+
         # decision AFTER kvstore/link-monitor so SPF sees self
         # (reference: Main.cpp:518 comment)
         self.decision.run()
@@ -304,6 +326,11 @@ class OpenrDaemon:
 
     def stop(self) -> None:
         """Reverse-order teardown (reference: Main.cpp:617-668)."""
+        if self._plugin is not None:
+            from .plugin import plugin_stop
+
+            plugin_stop(self._plugin, self._plugin_handle)
+            self._plugin = None
         if self.watchdog is not None:
             self.watchdog.stop()
         for queue in self._queues:

@@ -37,7 +37,10 @@ class SimulatedRouteTable:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._alive_since = int(time.time())
+        # millisecond resolution: a sub-second supervisor restart must
+        # still change aliveSince or Fib's keepalive never resyncs
+        # (MockFibAgent.restart makes the same guarantee)
+        self._alive_since = int(time.time() * 1000)
         self.unicast: dict[int, dict[str, UnicastRoute]] = {}
         self.mpls: dict[int, dict[int, MplsRoute]] = {}
         self.counters: dict[str, int] = {}
@@ -164,13 +167,19 @@ class FibAgentServer:
                     return
                 try:
                     msg = json.loads(line)
+                except ValueError:
+                    msg = None  # malformed line: error reply with no id
+                msg_id = msg.get("id") if isinstance(msg, dict) else None
+                try:
+                    if not isinstance(msg, dict):
+                        raise ValueError("malformed request")
                     result = self._dispatch(
                         msg.get("method", ""), from_wire(msg.get("params")) or {}
                     )
-                    reply = {"id": msg.get("id"), "result": to_wire(result)}
+                    reply = {"id": msg_id, "result": to_wire(result)}
                 except Exception as exc:  # surfaced to the client
                     reply = {
-                        "id": msg.get("id") if isinstance(msg, dict) else None,
+                        "id": msg_id,
                         "error": f"{type(exc).__name__}: {exc}",
                     }
                 writer.write(json.dumps(reply).encode() + b"\n")

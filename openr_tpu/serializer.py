@@ -13,6 +13,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 import json
+import typing
 from typing import Any, Type, TypeVar
 
 from . import types as T
@@ -56,9 +57,11 @@ def _from_jsonable(cls: Any, data: Any) -> Any:
         return None
     if isinstance(data, dict) and "__bytes__" in data:
         return bytes.fromhex(data["__bytes__"])
-    origin = getattr(cls, "__origin__", None)
+    # typing.get_origin/get_args normalize both typing.Optional/Union and
+    # PEP-604 `X | None` unions (which carry no __origin__ themselves)
+    origin = typing.get_origin(cls)
     if origin is not None:
-        args = cls.__args__
+        args = typing.get_args(cls)
         if origin is dict:
             return {
                 _key_from_str(args[0], k): _from_jsonable(args[1], v)
@@ -72,7 +75,7 @@ def _from_jsonable(cls: Any, data: Any) -> Any:
         if origin in (set, frozenset):
             elem = args[0] if args else Any
             return origin(_from_jsonable(elem, v) for v in data)
-        # Optional[X] / unions: try each member
+        # Optional[X] / unions (either spelling): try each member
         for arg in args:
             if arg is type(None):
                 continue
@@ -84,8 +87,6 @@ def _from_jsonable(cls: Any, data: Any) -> Any:
     if isinstance(cls, type) and issubclass(cls, enum.Enum):
         return cls(data)
     if dataclasses.is_dataclass(cls):
-        import typing
-
         hints = typing.get_type_hints(cls)
         kwargs = {}
         for f in dataclasses.fields(cls):

@@ -1,0 +1,163 @@
+"""Library-usage examples + plugin seam, run against live daemons
+(reference: /examples programs consumed openrlib the same way)."""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import os
+import sys
+
+import pytest
+
+# examples/ package lives at the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from examples.kvstore_agent import AGENT_KEY_PREFIX, KvStoreAgent
+from examples.kvstore_poller import poll
+from examples.route_injector_plugin import INJECTED_PREFIX
+from examples.set_rib_policy import main as set_rib_policy_main
+from openr_tpu.types import LinkEvent
+from tests.test_system import FIB_CLIENT, RingFixture, make_config, wait_for
+
+
+class TestKvStoreAgentExample:
+    def test_agents_exchange_data_across_ring(self):
+        fixture = RingFixture(3)
+        agents = []
+        try:
+            for daemon in fixture.daemons:
+                agent = KvStoreAgent(
+                    f"agent-{daemon.config.node_name}",
+                    daemon.kvstore,
+                    daemon.kvstore_updates_queue.get_reader(),
+                    change_interval_s=0.1,
+                )
+                agent.start()
+                agents.append(agent)
+            # every agent's persisted key floods to every node, and every
+            # agent observes the other two (the reference example's log)
+            assert wait_for(
+                lambda: all(len(a.peer_data) == 2 for a in agents)
+            ), [sorted(a.peer_data) for a in agents]
+            # persist-key ownership: the key is in every store
+            pub = fixture.daemons[0].kvstore.dump_all("0")
+            agent_keys = [
+                k for k in pub.key_vals if k.startswith(AGENT_KEY_PREFIX)
+            ]
+            assert len(agent_keys) == 3
+        finally:
+            for agent in agents:
+                agent.stop()
+            fixture.stop()
+
+
+class TestPollerAndPolicyExamples:
+    @pytest.fixture
+    def tcp_pair(self):
+        from openr_tpu.main import OpenrDaemon
+        from openr_tpu.spark import MockIoProvider
+        from tests.test_platform_agent import free_port
+
+        fabric = MockIoProvider()
+        ports = (free_port(), free_port())
+        daemons = []
+        for i, port in enumerate(ports):
+            cfg = make_config(f"ex-{i}", ctrl_port=port)
+            cfg.enable_rib_policy = True  # the SetRibPolicy example needs it
+            d = OpenrDaemon(
+                cfg,
+                io_provider=fabric.endpoint(f"ex-{i}"),
+                spark_v6_addr="::1",
+            )
+            d.start()
+            daemons.append(d)
+        fabric.connect("ex-0", "e0", "ex-1", "e1")
+        daemons[0].netlink_events_queue.push(LinkEvent("e0", 1, True))
+        daemons[1].netlink_events_queue.push(LinkEvent("e1", 1, True))
+        yield daemons, ports
+        for d in daemons:
+            d.stop()
+
+    def test_kvstore_poller(self, tcp_pair):
+        daemons, ports = tcp_pair
+        assert wait_for(
+            lambda: "adj:ex-1" in daemons[0].kvstore.dump_all("0").key_vals,
+            timeout=30,
+        )
+        result = poll([("::1", p) for p in ports])
+        tables = list(result.values())
+        assert all(t is not None for t in tables)
+        assert "adj:ex-0" in tables[0] and "adj:ex-0" in tables[1]
+        # unreachable endpoint reported as None, not an exception
+        down = poll([("::1", 1)])
+        assert list(down.values()) == [None]
+
+    def test_set_rib_policy_example(self, tcp_pair):
+        daemons, ports = tcp_pair
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            rc = set_rib_policy_main(
+                ["--port", str(ports[0]), "--prefix", "fc00::/64"]
+            )
+        assert rc == 0
+        assert "example-statement" in out.getvalue()
+        policy = daemons[0].decision.get_rib_policy()
+        assert policy.statements[0].name == "example-statement"
+
+
+class TestPluginSeam:
+    def test_route_injector_plugin_originates_and_observes(self):
+        """plugin_module config attaches examples.route_injector_plugin:
+        its BGP-type prefix must reach the OTHER node's FIB, and it must
+        see route updates (reference contract: Plugin.h queues)."""
+        from openr_tpu.main import OpenrDaemon
+        from openr_tpu.spark import MockIoProvider
+        from openr_tpu.types import normalize_prefix
+
+        from tests.test_platform_agent import free_port
+
+        fabric = MockIoProvider()
+        daemons = []
+        for i in range(2):
+            cfg = make_config(f"pl-{i}", ctrl_port=free_port())
+            if i == 0:
+                cfg.plugin_module = "examples.route_injector_plugin"
+            d = OpenrDaemon(
+                cfg,
+                io_provider=fabric.endpoint(f"pl-{i}"),
+                spark_v6_addr="::1",
+            )
+            d.start()
+            daemons.append(d)
+        fabric.connect("pl-0", "p0", "pl-1", "p1")
+        daemons[0].netlink_events_queue.push(LinkEvent("p0", 1, True))
+        daemons[1].netlink_events_queue.push(LinkEvent("p1", 1, True))
+        try:
+            assert daemons[0]._plugin_handle is not None
+            assert wait_for(
+                lambda: normalize_prefix(INJECTED_PREFIX)
+                in daemons[1].fib_agent.unicast.get(FIB_CLIENT, {}),
+                timeout=30,
+            ), "injected BGP prefix never reached the peer FIB"
+            assert wait_for(
+                lambda: daemons[0]._plugin_handle.seen_route_updates > 0
+            ), "plugin never observed a route update"
+        finally:
+            for d in daemons:
+                d.stop()
+
+    def test_bad_plugin_module_fails_loudly(self):
+        from openr_tpu.main import OpenrDaemon
+        from openr_tpu.spark import MockIoProvider
+
+        cfg = make_config("pl-bad")
+        cfg.plugin_module = "examples.no_such_plugin"
+        d = OpenrDaemon(
+            cfg,
+            io_provider=MockIoProvider().endpoint("pl-bad"),
+            spark_v6_addr="::1",
+        )
+        with pytest.raises(ImportError):
+            d.start()
+        d.stop()

@@ -88,7 +88,7 @@ class TestAgentWire:
 
     def test_alive_since_and_counters(self, pair):
         server, client = pair
-        assert client.alive_since() <= int(time.time())
+        assert client.alive_since() <= int(time.time() * 1000)
         client.add_unicast_routes(CLIENT, [route("fc00::/64", "a")])
         assert client.get_counters()["fibagent.add_unicast"] == 1
 

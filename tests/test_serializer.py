@@ -100,3 +100,31 @@ def test_key_helpers():
     assert adj_key("n1") == "adj:n1"
     assert prefix_key("n1", "10.0.0.1/24", "0") == "prefix:[n1]:[0]:[10.0.0.0/24]"
     assert normalize_prefix("10.0.0.1/24") == "10.0.0.0/24"
+
+
+def test_pep604_union_fields_round_trip():
+    """`X | None` fields (PEP-604 unions carry no __origin__) must decode
+    their nested dataclasses, same as typing.Optional[X]."""
+    from openr_tpu.decision.rib_policy import (
+        RibPolicyConfig,
+        RibPolicyStatementConfig,
+        RibRouteActionWeight,
+    )
+    from openr_tpu.serializer import from_wire, to_wire
+
+    cfg = RibPolicyConfig(
+        statements=[
+            RibPolicyStatementConfig(
+                name="s",
+                prefixes=["fc00::/64"],
+                set_weight=RibRouteActionWeight(
+                    default_weight=1, area_to_weight={"0": 2}
+                ),
+            )
+        ],
+        ttl_secs=60,
+    )
+    back = from_wire(to_wire(cfg))
+    stmt = back.statements[0]
+    assert isinstance(stmt.set_weight, RibRouteActionWeight)
+    assert stmt.set_weight.area_to_weight == {"0": 2}
