@@ -8,14 +8,14 @@ Run: python -m examples.kvstore_poller host:port [host:port ...]
 from __future__ import annotations
 
 import sys
-from typing import Iterable
+from typing import Iterable, Optional
 
 from openr_tpu.ctrl import CtrlClient
 
 
 def poll(
     endpoints: Iterable[tuple[str, int]], area: str = "0"
-) -> dict[str, dict[str, object]]:
+) -> dict[str, Optional[dict[str, object]]]:
     """{endpoint: {key: Value}} for every reachable endpoint; unreachable
     endpoints map to None (the reference logs and skips them)."""
     out: dict[str, dict[str, object]] = {}
@@ -42,6 +42,9 @@ def main(argv: list[str] | None = None) -> int:
     endpoints = []
     for spec in args:
         host, _, port = spec.rpartition(":")
+        if not port.isdigit():
+            print(f"bad endpoint {spec!r} (expected host:port)")
+            return 2
         endpoints.append((host or "::1", int(port)))
     for name, keys in poll(endpoints).items():
         if keys is None:

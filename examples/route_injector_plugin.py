@@ -58,7 +58,10 @@ class _Injector:
             )
 
     def stop(self) -> None:
-        self._thread.join(0.1)
+        # unblock the tail thread's get() deterministically (the daemon
+        # stops plugins before it closes the queues)
+        self._reader.close()
+        self._thread.join(1.0)
 
 
 def plugin_start(args) -> _Injector:

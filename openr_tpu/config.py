@@ -123,6 +123,10 @@ class OpenrConfig:
     # import path of a plugin module exposing plugin_start(PluginArgs)
     # (reference: the BGP-speaker seam, Plugin.h:23-32 + Main.cpp:501-510)
     plugin_module: str = ""
+    # real kernel link/address events via rtnetlink (reference: the nl/
+    # NetlinkProtocolSocket producer, Main.cpp:330-343); off by default —
+    # tests and mock-fabric deployments inject events directly
+    enable_netlink: bool = False
     kvstore_config: KvStoreConf = field(default_factory=KvStoreConf)
     link_monitor_config: LinkMonitorConf = field(default_factory=LinkMonitorConf)
     decision_config: DecisionConf = field(default_factory=DecisionConf)

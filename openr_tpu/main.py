@@ -218,6 +218,7 @@ class OpenrDaemon:
         self.ctrl_server: Optional[CtrlServer] = None
         self._plugin = None
         self._plugin_handle = None
+        self.netlink = None
         self._ctrl_port_override = ctrl_port
         self._started = False
 
@@ -226,6 +227,14 @@ class OpenrDaemon:
     def start(self) -> None:
         assert not self._started
         self._started = True
+        # netlink FIRST so the initial kernel state replay is queued before
+        # LinkMonitor starts consuming (reference: Main.cpp:330-343 brings
+        # the netlink evb up before every module)
+        if self.config.enable_netlink:
+            from .nl import NetlinkProtocolSocket
+
+            self.netlink = NetlinkProtocolSocket(self.netlink_events_queue)
+            self.netlink.run()
         modules = [self.monitor, self.kvstore, self.spark, self.link_monitor]
         for module in modules:
             module.run()
@@ -355,6 +364,10 @@ class OpenrDaemon:
         for module in modules:
             if module is not None:
                 module.wait_until_stopped(5)
+        if self.netlink is not None:
+            self.netlink.stop()
+            self.netlink.wait_until_stopped(5)
+            self.netlink = None
         close_agent = getattr(self.fib_agent, "close", None)
         if callable(close_agent):
             close_agent()  # TcpFibAgent holds a persistent socket

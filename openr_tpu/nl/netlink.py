@@ -1,0 +1,322 @@
+"""rtnetlink codec + event socket, from scratch (no pyroute2 et al).
+
+Reference surface reproduced (openr/nl/):
+- message codec: NetlinkMessage framing (NetlinkMessage.h:39) for
+  RTM_GETLINK / RTM_GETADDR dumps and RTM_NEWLINK / DELLINK / NEWADDR /
+  DELADDR event parsing (NetlinkRoute.h:177 NetlinkLinkMessage, :214
+  NetlinkAddrMessage)
+- `NetlinkProtocolSocket` (NetlinkProtocolSocket.h:96): AF_NETLINK socket
+  in its own event base, initial full dumps, kernel multicast-group
+  subscription (RTMGRP_LINK + v4/v6 IFADDR), typed events pushed to the
+  daemon's netlink-events queue — the producer the LinkMonitor dataflow
+  starts from (SURVEY §1: netlink -> netlinkEventsQueue -> LinkMonitor).
+
+Only the link/address surface is implemented natively; route programming
+goes through the platform agent (openr_tpu.platform), which is this
+framework's FibService boundary.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ipaddress
+import socket
+import struct
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+from ..runtime.eventbase import OpenrEventBase
+from ..runtime.queue import ReplicateQueue
+from ..types import AddrEvent, LinkEvent
+
+# netlink protocol constants (linux/netlink.h, linux/rtnetlink.h)
+NETLINK_ROUTE = 0
+
+NLMSG_NOOP = 1
+NLMSG_ERROR = 2
+NLMSG_DONE = 3
+
+NLM_F_REQUEST = 0x01
+NLM_F_MULTI = 0x02
+NLM_F_ROOT = 0x100
+NLM_F_MATCH = 0x200
+NLM_F_DUMP = NLM_F_ROOT | NLM_F_MATCH
+
+RTM_NEWLINK = 16
+RTM_DELLINK = 17
+RTM_GETLINK = 18
+RTM_NEWADDR = 20
+RTM_DELADDR = 21
+RTM_GETADDR = 22
+
+RTMGRP_LINK = 0x1
+RTMGRP_IPV4_IFADDR = 0x10
+RTMGRP_IPV6_IFADDR = 0x100
+
+IFF_UP = 0x1
+IFF_RUNNING = 0x40
+
+IFLA_IFNAME = 3
+IFA_ADDRESS = 1
+IFA_LOCAL = 2
+
+_NLMSGHDR = struct.Struct("=IHHII")  # len, type, flags, seq, pid
+_IFINFOMSG = struct.Struct("=BxHiII")  # family, type, index, flags, change
+_IFADDRMSG = struct.Struct("=BBBBi")  # family, prefixlen, flags, scope, index
+_RTATTR = struct.Struct("=HH")  # len, type
+_GENMSG = struct.Struct("=Bxxx")  # rtgenmsg: family
+
+
+class NetlinkError(OSError):
+    pass
+
+
+def _align4(n: int) -> int:
+    return (n + 3) & ~3
+
+
+def _walk_rtattrs(data: bytes) -> Iterator[tuple[int, bytes]]:
+    """Yield (attr_type, payload) over an rtattr chain."""
+    off = 0
+    while off + _RTATTR.size <= len(data):
+        alen, atype = _RTATTR.unpack_from(data, off)
+        if alen < _RTATTR.size:
+            return
+        yield atype, data[off + _RTATTR.size : off + alen]
+        off += _align4(alen)
+
+
+@dataclass(slots=True)
+class LinkInfo:
+    """Reference: openr::fbnl::Link (NetlinkTypes.h)."""
+
+    if_index: int
+    if_name: str
+    flags: int
+
+    @property
+    def is_up(self) -> bool:
+        return bool(self.flags & IFF_UP)
+
+
+@dataclass(slots=True)
+class AddrInfo:
+    """Reference: openr::fbnl::IfAddress (NetlinkTypes.h)."""
+
+    if_index: int
+    family: int
+    prefix: str  # CIDR
+    is_valid: bool = True  # False for RTM_DELADDR
+
+
+@dataclass(slots=True)
+class NetlinkMsg:
+    msg_type: int
+    link: Optional[LinkInfo] = None
+    addr: Optional[AddrInfo] = None
+    error: int = 0
+
+
+def _parse_link(payload: bytes) -> LinkInfo:
+    family, _type, index, flags, _change = _IFINFOMSG.unpack_from(payload, 0)
+    name = ""
+    for atype, adata in _walk_rtattrs(payload[_IFINFOMSG.size :]):
+        if atype == IFLA_IFNAME:
+            name = adata.rstrip(b"\x00").decode()
+    return LinkInfo(if_index=index, if_name=name, flags=flags)
+
+
+def _parse_addr(payload: bytes, deleted: bool) -> Optional[AddrInfo]:
+    family, prefixlen, _flags, _scope, index = _IFADDRMSG.unpack_from(
+        payload, 0
+    )
+    address: Optional[bytes] = None
+    local: Optional[bytes] = None
+    for atype, adata in _walk_rtattrs(payload[_IFADDRMSG.size :]):
+        if atype == IFA_ADDRESS:
+            address = adata
+        elif atype == IFA_LOCAL:
+            local = adata
+    raw = local or address  # IFA_LOCAL is the interface address on v4 ptp
+    if raw is None:
+        return None
+    try:
+        ip = ipaddress.ip_address(raw)
+    except ValueError:
+        return None
+    return AddrInfo(
+        if_index=index,
+        family=family,
+        prefix=f"{ip}/{prefixlen}",
+        is_valid=not deleted,
+    )
+
+
+def parse_messages(data: bytes) -> Iterator[NetlinkMsg]:
+    """Parse a datagram of (possibly multipart) netlink messages."""
+    off = 0
+    while off + _NLMSGHDR.size <= len(data):
+        mlen, mtype, _flags, _seq, _pid = _NLMSGHDR.unpack_from(data, off)
+        if mlen < _NLMSGHDR.size or off + mlen > len(data):
+            return
+        payload = data[off + _NLMSGHDR.size : off + mlen]
+        if mtype == NLMSG_DONE:
+            yield NetlinkMsg(msg_type=NLMSG_DONE)
+        elif mtype == NLMSG_ERROR:
+            (errno_neg,) = struct.unpack_from("=i", payload, 0)
+            yield NetlinkMsg(msg_type=NLMSG_ERROR, error=-errno_neg)
+        elif mtype in (RTM_NEWLINK, RTM_DELLINK):
+            yield NetlinkMsg(msg_type=mtype, link=_parse_link(payload))
+        elif mtype in (RTM_NEWADDR, RTM_DELADDR):
+            addr = _parse_addr(payload, deleted=mtype == RTM_DELADDR)
+            if addr is not None:
+                yield NetlinkMsg(msg_type=mtype, addr=addr)
+        off += _align4(mlen)
+
+
+def build_dump_request(msg_type: int, seq: int, family: int = 0) -> bytes:
+    """RTM_GETLINK / RTM_GETADDR full-dump request
+    (reference: NetlinkLinkMessage::init dump flags)."""
+    length = _NLMSGHDR.size + _GENMSG.size
+    return _NLMSGHDR.pack(
+        length, msg_type, NLM_F_REQUEST | NLM_F_DUMP, seq, 0
+    ) + _GENMSG.pack(family)
+
+
+class NetlinkProtocolSocket(OpenrEventBase):
+    """Kernel link/address mirror + event subscription
+    (reference: NetlinkProtocolSocket, NetlinkProtocolSocket.h:96; owned
+    by its own event base per Main.cpp:330-343).
+
+    Pushes LinkEvent/AddrEvent into `netlink_events_queue` — first a full
+    synthetic replay of current kernel state (so LinkMonitor starts from
+    truth), then live kernel multicast events."""
+
+    def __init__(
+        self,
+        netlink_events_queue: ReplicateQueue,
+        groups: int = RTMGRP_LINK | RTMGRP_IPV4_IFADDR | RTMGRP_IPV6_IFADDR,
+    ) -> None:
+        super().__init__(name="netlink")
+        self.netlink_events_queue = netlink_events_queue
+        self._groups = groups
+        self._sock: Optional[socket.socket] = None
+        self._seq = 0
+        self.links: dict[int, LinkInfo] = {}  # kernel mirror by ifindex
+        self.counters: dict[str, int] = {}
+
+    def _bump(self, counter: str, n: int = 1) -> None:
+        self.counters[counter] = self.counters.get(counter, 0) + n
+
+    # -- synchronous dump API (reference: getAllLinks/getAllIfAddresses) ----
+
+    def _dump(self, msg_type: int, family: int = 0) -> list[NetlinkMsg]:
+        """One blocking dump request/response on a throwaway socket."""
+        self._seq += 1
+        sock = socket.socket(socket.AF_NETLINK, socket.SOCK_RAW, NETLINK_ROUTE)
+        try:
+            sock.bind((0, 0))
+            sock.settimeout(5.0)
+            sock.send(build_dump_request(msg_type, self._seq, family))
+            out: list[NetlinkMsg] = []
+            while True:
+                data = sock.recv(65536)
+                done = False
+                for msg in parse_messages(data):
+                    if msg.msg_type == NLMSG_DONE:
+                        done = True
+                        break
+                    if msg.msg_type == NLMSG_ERROR and msg.error:
+                        raise NetlinkError(msg.error, "netlink dump error")
+                    out.append(msg)
+                if done:
+                    return out
+        finally:
+            sock.close()
+
+    def get_all_links(self) -> list[LinkInfo]:
+        return [m.link for m in self._dump(RTM_GETLINK) if m.link]
+
+    def get_all_addresses(self) -> list[AddrInfo]:
+        return [m.addr for m in self._dump(RTM_GETADDR) if m.addr]
+
+    # -- event subscription --------------------------------------------------
+
+    def run(self) -> None:
+        super().run()
+        self.wait_until_running()
+        self.run_in_event_base_thread(self._setup).result()
+
+    def _setup(self) -> None:
+        sock = socket.socket(socket.AF_NETLINK, socket.SOCK_RAW, NETLINK_ROUTE)
+        sock.bind((0, self._groups))
+        sock.setblocking(False)
+        self._sock = sock
+
+        # initial state replay: links first, then addresses (LinkMonitor
+        # needs the link before its addresses; reference does the same
+        # ordered bootstrap)
+        for link in self.get_all_links():
+            self.links[link.if_index] = link
+            self.netlink_events_queue.push(
+                LinkEvent(link.if_name, link.if_index, link.is_up)
+            )
+            self._bump("netlink.links")
+        for addr in self.get_all_addresses():
+            link = self.links.get(addr.if_index)
+            if link is None:
+                continue
+            self.netlink_events_queue.push(
+                AddrEvent(link.if_name, addr.prefix, addr.is_valid)
+            )
+            self._bump("netlink.addrs")
+
+        self._loop.add_reader(sock.fileno(), self._on_readable)
+
+    def _on_readable(self) -> None:
+        try:
+            data = self._sock.recv(65536)
+        except BlockingIOError:
+            return
+        except OSError:
+            return
+        for msg in parse_messages(data):
+            self._bump("netlink.events")
+            if msg.link is not None:
+                link = msg.link
+                if msg.msg_type == RTM_DELLINK:
+                    self.links.pop(link.if_index, None)
+                    self.netlink_events_queue.push(
+                        LinkEvent(link.if_name, link.if_index, False)
+                    )
+                else:
+                    prev = self.links.get(link.if_index)
+                    self.links[link.if_index] = link
+                    if prev is None or prev.is_up != link.is_up:
+                        self.netlink_events_queue.push(
+                            LinkEvent(link.if_name, link.if_index, link.is_up)
+                        )
+            elif msg.addr is not None:
+                link = self.links.get(msg.addr.if_index)
+                if link is None:
+                    continue
+                self.netlink_events_queue.push(
+                    AddrEvent(link.if_name, msg.addr.prefix, msg.addr.is_valid)
+                )
+
+    def stop(self) -> None:  # type: ignore[override]
+        if self._sock is not None and self._loop is not None:
+            sock = self._sock
+
+            def _close():
+                try:
+                    self._loop.remove_reader(sock.fileno())
+                finally:
+                    sock.close()
+
+            try:
+                self.run_in_event_base_thread(_close).result(timeout=5)
+            except Exception:
+                pass
+            self._sock = None
+        super().stop()

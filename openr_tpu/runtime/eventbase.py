@@ -148,8 +148,10 @@ class OpenrEventBase:
         return self._started.wait(timeout)
 
     def wait_until_stopped(self, timeout: Optional[float] = None) -> bool:
+        if self._thread is None:
+            return True  # never started (e.g. startup aborted mid-way)
         ok = self._stopped.wait(timeout)
-        if ok and self._thread is not None:
+        if ok:
             self._thread.join()
         return ok
 

@@ -50,6 +50,12 @@ class RQueue(Generic[T]):
     def is_closed(self) -> bool:
         return self._impl.is_closed()
 
+    def close(self) -> None:
+        """Reader-side close: unblocks pending get()s with
+        QueueClosedError; a ReplicateQueue prunes the dead reader on its
+        next push (reference: dead-reader handling in ReplicateQueue)."""
+        self._impl.close()
+
 
 class RWQueue(Generic[T]):
     def __init__(self) -> None:
