@@ -435,6 +435,10 @@ def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(prog="breeze", description=__doc__)
     parser.add_argument("-H", "--host", default="::1")
     parser.add_argument("-p", "--port", type=int, default=2018)
+    # mTLS against a TLS-enabled ctrl server (cert CN must pass its ACL)
+    parser.add_argument("--tls-cert", default=None)
+    parser.add_argument("--tls-key", default=None)
+    parser.add_argument("--tls-ca", default=None)
     sub = parser.add_subparsers(dest="group", required=True)
 
     kv = sub.add_parser("kvstore").add_subparsers(dest="cmd", required=True)
@@ -548,7 +552,19 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
-    client = CtrlClient(args.host, args.port)
+    tls = None
+    if args.tls_cert or args.tls_key or args.tls_ca:
+        if not (args.tls_cert and args.tls_key and args.tls_ca):
+            print("error: --tls-cert, --tls-key and --tls-ca are all required")
+            return 2
+        from ..ctrl.tls import TlsConfig
+
+        tls = TlsConfig(
+            cert_path=args.tls_cert,
+            key_path=args.tls_key,
+            ca_path=args.tls_ca,
+        )
+    client = CtrlClient(args.host, args.port, tls=tls)
     try:
         args.fn(client, args)
         return 0

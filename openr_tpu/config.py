@@ -96,6 +96,19 @@ class AreaConf:
 
 @register_type
 @dataclass(slots=True)
+class TlsConf:
+    """mTLS + peer-name ACL on the ctrl transport (reference: wangle TLS
+    setup + client-CN allowlist, openr/Main.cpp:546-612; flags
+    --x509_cert_path/--x509_key_path/--x509_ca_path/--tls_acl_cache...)."""
+
+    cert_path: str = ""
+    key_path: str = ""
+    ca_path: str = ""
+    acl_regex: str = ".*"  # allowed client certificate CommonNames
+
+
+@register_type
+@dataclass(slots=True)
 class OpenrConfig:
     """Reference: thrift::OpenrConfig (OpenrConfig.thrift:400)."""
 
@@ -127,6 +140,7 @@ class OpenrConfig:
     # NetlinkProtocolSocket producer, Main.cpp:330-343); off by default —
     # tests and mock-fabric deployments inject events directly
     enable_netlink: bool = False
+    tls_config: Optional[TlsConf] = None
     kvstore_config: KvStoreConf = field(default_factory=KvStoreConf)
     link_monitor_config: LinkMonitorConf = field(default_factory=LinkMonitorConf)
     decision_config: DecisionConf = field(default_factory=DecisionConf)
@@ -156,6 +170,22 @@ class OpenrConfig:
             pac = self.prefix_allocation_config
             if not pac.seed_prefix:
                 raise ConfigError("prefix allocation requires seed_prefix")
+        if self.tls_config is not None and (
+            self.tls_config.cert_path
+            or self.tls_config.key_path
+            or self.tls_config.ca_path
+        ):
+            tc = self.tls_config
+            if not (tc.cert_path and tc.key_path and tc.ca_path):
+                raise ConfigError(
+                    "tls_config requires cert_path, key_path and ca_path"
+                )
+            try:
+                re.compile(tc.acl_regex)
+            except re.error as e:
+                raise ConfigError(
+                    f"bad tls acl_regex {tc.acl_regex!r}: {e}"
+                ) from e
         if not (0 < self.openr_ctrl_port < 65536) and self.openr_ctrl_port != 0:
             raise ConfigError(f"bad ctrl port {self.openr_ctrl_port}")
         return self

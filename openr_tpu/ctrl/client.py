@@ -20,11 +20,16 @@ class CtrlClient:
     """Blocking NDJSON-RPC client (one TCP connection, serial requests)."""
 
     def __init__(
-        self, host: str = "::1", port: int = 2018, timeout_s: float = 10.0
+        self,
+        host: str = "::1",
+        port: int = 2018,
+        timeout_s: float = 10.0,
+        tls=None,  # Optional[tls.TlsConfig] — client cert for mTLS
     ) -> None:
         self.host = host
         self.port = port
         self.timeout_s = timeout_s
+        self.tls = tls
         self._sock: Optional[socket.socket] = None
         self._rfile = None
         self._next_id = 0
@@ -33,9 +38,18 @@ class CtrlClient:
     def _connect(self) -> None:
         if self._sock is not None:
             return
-        self._sock = socket.create_connection(
+        sock = socket.create_connection(
             (self.host, self.port), timeout=self.timeout_s
         )
+        if self.tls is not None:
+            from .tls import client_context
+
+            try:
+                sock = client_context(self.tls).wrap_socket(sock)
+            except Exception:
+                sock.close()  # don't leak the raw fd on handshake failure
+                raise
+        self._sock = sock
         self._rfile = self._sock.makefile("rb")
 
     def close(self) -> None:
@@ -110,15 +124,29 @@ class TcpKvStoreTransport:
     short-lived connection per request (reconnect cost is absorbed by the
     peer FSM's backoff)."""
 
-    def __init__(self, default_port: int = 2018, timeout_s: float = 10.0) -> None:
+    def __init__(
+        self,
+        default_port: int = 2018,
+        timeout_s: float = 10.0,
+        tls=None,  # Optional[tls.TlsConfig] — peers require our cert too
+    ) -> None:
         self.default_port = default_port
         self.timeout_s = timeout_s
+        self.tls = tls
+        # built eagerly: cert loading is blocking disk I/O that must not
+        # run on the KvStore event loop, and bad paths should fail here
+        self._ssl_ctx = None
+        if tls is not None:
+            from .tls import client_context
+
+            self._ssl_ctx = client_context(tls)
 
     async def _call(self, peer: PeerSpec, method: str, params: dict) -> Any:
         host = peer.peer_addr
         port = peer.ctrl_port or self.default_port
         reader, writer = await asyncio.wait_for(
-            asyncio.open_connection(host, port), self.timeout_s
+            asyncio.open_connection(host, port, ssl=self._ssl_ctx),
+            self.timeout_s,
         )
         try:
             request = {"id": 1, "method": method, "params": to_wire(params)}

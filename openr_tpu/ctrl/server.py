@@ -326,11 +326,13 @@ class CtrlServer(OpenrEventBase):
         handler: OpenrCtrlHandler,
         host: str = "::1",
         port: int = 2018,
+        tls=None,  # Optional[tls.TlsConfig] — mTLS + peer-name ACL
     ) -> None:
         super().__init__(name="ctrl-server")
         self.handler = handler
         self.host = host
         self.port = port
+        self.tls = tls
         self._server: Optional[asyncio.AbstractServer] = None
 
     def run(self) -> None:
@@ -340,8 +342,13 @@ class CtrlServer(OpenrEventBase):
         fut.result(timeout=10)
 
     async def _start(self) -> None:
+        ssl_ctx = None
+        if self.tls is not None:
+            from .tls import server_context
+
+            ssl_ctx = server_context(self.tls)
         self._server = await asyncio.start_server(
-            self._handle_conn, self.host, self.port
+            self._handle_conn, self.host, self.port, ssl=ssl_ctx
         )
         if self.port == 0:  # ephemeral: record the real port
             self.port = self._server.sockets[0].getsockname()[1]
@@ -362,6 +369,22 @@ class CtrlServer(OpenrEventBase):
     async def _handle_conn(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
+        # peer-name ACL (reference: Main.cpp:546-612 wires the client-CN
+        # allowlist into the thrift server's TLS policy)
+        if self.tls is not None:
+            from .tls import check_acl, peer_common_name
+
+            ssl_object = writer.get_extra_info("ssl_object")
+            peer_cn = peer_common_name(ssl_object) if ssl_object else None
+            if not check_acl(self.tls, peer_cn):
+                log.warning(
+                    "ctrl: rejecting peer %r (ACL %r)",
+                    peer_cn,
+                    self.tls.acl_regex,
+                )
+                writer.close()
+                return
+
         streams: dict[int, asyncio.Task] = {}
         write_lock = asyncio.Lock()
 

@@ -1,0 +1,60 @@
+"""mTLS for the ctrl transport (reference: wangle TLS + peer-name ACL on
+the thrift server, openr/Main.cpp:546-612).
+
+Both sides present CA-signed certificates; the server additionally gates
+connections on the client certificate's CommonName matching an ACL regex
+(the reference's peer-name allowlist).  Hostname verification is
+deliberately off on the client — routers connect by link-local/loopback
+address, and identity is the certificate name, exactly as in the
+reference's deployment model.
+"""
+
+from __future__ import annotations
+
+import re
+import ssl
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass(slots=True)
+class TlsConfig:
+    cert_path: str
+    key_path: str
+    ca_path: str
+    acl_regex: str = ".*"  # client-CN allowlist (server side only)
+
+
+def server_context(cfg: TlsConfig) -> ssl.SSLContext:
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cfg.cert_path, cfg.key_path)
+    ctx.load_verify_locations(cfg.ca_path)
+    ctx.verify_mode = ssl.CERT_REQUIRED  # mTLS: clients must present certs
+    return ctx
+
+
+def client_context(cfg: TlsConfig) -> ssl.SSLContext:
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.load_cert_chain(cfg.cert_path, cfg.key_path)
+    ctx.load_verify_locations(cfg.ca_path)
+    ctx.check_hostname = False  # identity = certificate name, not address
+    ctx.verify_mode = ssl.CERT_REQUIRED
+    return ctx
+
+
+def peer_common_name(ssl_object) -> Optional[str]:
+    """CommonName of the peer certificate, or None."""
+    cert = ssl_object.getpeercert()
+    if not cert:
+        return None
+    for rdn in cert.get("subject", ()):
+        for key, value in rdn:
+            if key == "commonName":
+                return value
+    return None
+
+
+def check_acl(cfg: TlsConfig, common_name: Optional[str]) -> bool:
+    if common_name is None:
+        return False
+    return re.fullmatch(cfg.acl_regex, common_name) is not None

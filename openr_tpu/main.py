@@ -123,7 +123,9 @@ class OpenrDaemon:
             self.kvstore_sync_events_queue,
             self.peer_updates_queue.get_reader(),
             transport=kvstore_transport
-            or TcpKvStoreTransport(default_port=config.openr_ctrl_port),
+            or TcpKvStoreTransport(
+                default_port=config.openr_ctrl_port, tls=self._tls_config()
+            ),
             areas=areas,
             filters=(
                 KvStoreFilters(kvc.key_prefix_filters)
@@ -322,11 +324,26 @@ class OpenrDaemon:
                 if self._ctrl_port_override is not None
                 else self.config.openr_ctrl_port
             ),
+            tls=self._tls_config(),
         )
         self.ctrl_server.run()
         if self.watchdog is not None:
             self.watchdog.add_evb(self.ctrl_server)
             self.watchdog.start()
+
+    def _tls_config(self):
+        """config.TlsConf -> ctrl.tls.TlsConfig (None when TLS is off)."""
+        tc = self.config.tls_config
+        if tc is None or not tc.cert_path:
+            return None
+        from .ctrl.tls import TlsConfig
+
+        return TlsConfig(
+            cert_path=tc.cert_path,
+            key_path=tc.key_path,
+            ca_path=tc.ca_path,
+            acl_regex=tc.acl_regex,
+        )
 
     @property
     def ctrl_port(self) -> int:
@@ -374,7 +391,11 @@ class OpenrDaemon:
         self.config_store.close()
 
 
-def main(argv: Optional[list[str]] = None) -> int:
+def build_flag_parser() -> argparse.ArgumentParser:
+    """Process-level flag surface (reference: openr/common/Flags.cpp — the
+    operationally-relevant subset; most knobs live in the JSON config, and
+    every flag here overrides its config field, mirroring GflagConfig's
+    flag->config bridge, openr/config/GflagConfig.h)."""
     parser = argparse.ArgumentParser(description="openr_tpu daemon")
     parser.add_argument("--config", required=True, help="JSON config file")
     parser.add_argument(
@@ -389,12 +410,103 @@ def main(argv: Optional[list[str]] = None) -> int:
         action="store_false",
         help="force the host Dijkstra SPF backend",
     )
-    args = parser.parse_args(argv)
+    # identity / ports (reference: --node_name, --openr_ctrl_port,
+    # --fib_port)
+    parser.add_argument("--node-name", default=None)
+    parser.add_argument("--listen-addr", default=None)
+    parser.add_argument("--openr-ctrl-port", type=int, default=None)
+    parser.add_argument("--fib-agent-host", default=None)
+    parser.add_argument("--fib-agent-port", type=int, default=None)
+    # drain / operation (reference: --assume_drained,
+    # --override_drain_state, --dryrun, --enable_watchdog)
+    parser.add_argument("--assume-drained", action="store_true", default=None)
+    parser.add_argument(
+        "--override-drain-state", action="store_true", default=None
+    )
+    parser.add_argument("--dryrun", action="store_true", default=None)
+    parser.add_argument(
+        "--disable-watchdog",
+        dest="enable_watchdog",
+        action="store_false",
+        default=None,
+    )
+    # features (reference: --enable_flood_optimization, --is_flood_root,
+    # --enable_netlink analog, --bgp_use_igp_metric plugin seam)
+    parser.add_argument(
+        "--enable-flood-optimization", action="store_true", default=None
+    )
+    parser.add_argument("--enable-netlink", action="store_true", default=None)
+    parser.add_argument("--plugin-module", default=None)
+    # decision timers (reference: --decision_debounce_min/max_ms)
+    parser.add_argument("--decision-debounce-min-ms", type=int, default=None)
+    parser.add_argument("--decision-debounce-max-ms", type=int, default=None)
+    # persistent state (reference: --config_store_filepath)
+    parser.add_argument("--config-store-path", default=None)
+    # ctrl mTLS + peer ACL (reference: --x509_cert_path etc.)
+    parser.add_argument("--tls-cert-path", default=None)
+    parser.add_argument("--tls-key-path", default=None)
+    parser.add_argument("--tls-ca-path", default=None)
+    parser.add_argument("--tls-acl-regex", default=None)
+    parser.add_argument("-v", "--verbose", action="store_true")
+    return parser
+
+
+def apply_flag_overrides(config, args) -> None:
+    """Flag-over-config precedence (reference: GflagConfig bridge)."""
+    overrides = {
+        "node_name": args.node_name,
+        "listen_addr": args.listen_addr,
+        "openr_ctrl_port": args.openr_ctrl_port,
+        "fib_agent_host": args.fib_agent_host,
+        "fib_agent_port": args.fib_agent_port,
+        "assume_drained": args.assume_drained,
+        "override_drain_state": args.override_drain_state,
+        "dryrun": args.dryrun,
+        "enable_watchdog": args.enable_watchdog,
+        "enable_netlink": args.enable_netlink,
+        "plugin_module": args.plugin_module,
+        "persistent_config_store_path": args.config_store_path,
+    }
+    for name, value in overrides.items():
+        if value is not None:
+            setattr(config, name, value)
+    if (
+        args.tls_cert_path
+        or args.tls_key_path
+        or args.tls_ca_path
+        or args.tls_acl_regex
+    ):
+        from .config import TlsConf
+
+        tls = config.tls_config or TlsConf()
+        for cfg_field, flag in (
+            ("cert_path", args.tls_cert_path),
+            ("key_path", args.tls_key_path),
+            ("ca_path", args.tls_ca_path),
+            ("acl_regex", args.tls_acl_regex),
+        ):
+            if flag is not None:
+                setattr(tls, cfg_field, flag)
+        config.tls_config = tls
+    if args.enable_flood_optimization is not None:
+        config.kvstore_config.enable_flood_optimization = (
+            args.enable_flood_optimization
+        )
+    if args.decision_debounce_min_ms is not None:
+        config.decision_config.debounce_min_ms = args.decision_debounce_min_ms
+    if args.decision_debounce_max_ms is not None:
+        config.decision_config.debounce_max_ms = args.decision_debounce_max_ms
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    args = build_flag_parser().parse_args(argv)
     logging.basicConfig(
-        level=logging.INFO,
+        level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
     config = load_config(args.config)
+    apply_flag_overrides(config, args)
+    config.validate()
     daemon = OpenrDaemon(config, use_device_spf=args.use_device_spf)
     daemon.start()
     log.info(
@@ -404,8 +516,9 @@ def main(argv: Optional[list[str]] = None) -> int:
         daemon.ctrl_port,
     )
     stop_event = threading.Event()
-    signal.signal(signal.SIGINT, lambda *a: stop_event.set())
-    signal.signal(signal.SIGTERM, lambda *a: stop_event.set())
+    if threading.current_thread() is threading.main_thread():
+        signal.signal(signal.SIGINT, lambda *a: stop_event.set())
+        signal.signal(signal.SIGTERM, lambda *a: stop_event.set())
     stop_event.wait()
     daemon.stop()
     return 0

@@ -1,0 +1,85 @@
+"""Process-level flag surface (reference: openr/common/Flags.cpp +
+GflagConfig's flag-over-config precedence)."""
+
+from __future__ import annotations
+
+import json
+
+from openr_tpu.config import OpenrConfig, load_config
+from openr_tpu.main import apply_flag_overrides, build_flag_parser
+
+
+def parse(args):
+    return build_flag_parser().parse_args(["--config", "/dev/null", *args])
+
+
+def base_config() -> OpenrConfig:
+    return OpenrConfig(node_name="from-config")
+
+
+class TestFlagOverrides:
+    def test_no_flags_keeps_config(self):
+        cfg = base_config()
+        apply_flag_overrides(cfg, parse([]))
+        assert cfg.node_name == "from-config"
+        assert cfg.assume_drained is False
+        assert cfg.tls_config is None
+
+    def test_identity_and_port_flags(self):
+        cfg = base_config()
+        apply_flag_overrides(
+            cfg,
+            parse(
+                ["--node-name", "flagged", "--openr-ctrl-port", "1234",
+                 "--fib-agent-port", "60100"]
+            ),
+        )
+        assert cfg.node_name == "flagged"
+        assert cfg.openr_ctrl_port == 1234
+        assert cfg.fib_agent_port == 60100
+
+    def test_drain_and_feature_flags(self):
+        cfg = base_config()
+        apply_flag_overrides(
+            cfg,
+            parse(
+                ["--assume-drained", "--dryrun", "--enable-flood-optimization",
+                 "--disable-watchdog", "--decision-debounce-min-ms", "1",
+                 "--decision-debounce-max-ms", "5"]
+            ),
+        )
+        assert cfg.assume_drained and cfg.dryrun
+        assert cfg.enable_watchdog is False
+        assert cfg.kvstore_config.enable_flood_optimization
+        assert cfg.decision_config.debounce_min_ms == 1
+        assert cfg.decision_config.debounce_max_ms == 5
+
+    def test_tls_flags_build_config(self):
+        cfg = base_config()
+        apply_flag_overrides(
+            cfg,
+            parse(
+                ["--tls-cert-path", "/c", "--tls-key-path", "/k",
+                 "--tls-ca-path", "/a", "--tls-acl-regex", "node-.*"]
+            ),
+        )
+        assert cfg.tls_config.cert_path == "/c"
+        assert cfg.tls_config.acl_regex == "node-.*"
+
+    def test_config_file_with_tls_section(self, tmp_path):
+        path = tmp_path / "conf.json"
+        path.write_text(
+            json.dumps(
+                {
+                    "node_name": "n1",
+                    "tls_config": {
+                        "cert_path": "/c",
+                        "key_path": "/k",
+                        "ca_path": "/a",
+                    },
+                }
+            )
+        )
+        cfg = load_config(str(path))
+        assert cfg.tls_config.cert_path == "/c"
+        assert cfg.tls_config.acl_regex == ".*"

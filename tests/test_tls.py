@@ -1,0 +1,146 @@
+"""mTLS + peer-name ACL on the ctrl transport (reference: wangle TLS and
+client-CN allowlist, openr/Main.cpp:546-612).  Certificates are minted
+with the system openssl; two daemons peer over real TLS sockets, plaintext
+and ACL-failing clients are rejected."""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import subprocess
+
+import pytest
+
+from openr_tpu.cli import breeze
+from openr_tpu.ctrl import CtrlClient
+from openr_tpu.ctrl.tls import TlsConfig, check_acl
+from openr_tpu.config import TlsConf
+from openr_tpu.main import OpenrDaemon
+from openr_tpu.spark import MockIoProvider
+from openr_tpu.types import LinkEvent, PrefixEntry, PrefixType, normalize_prefix
+from tests.test_platform_agent import free_port
+from tests.test_system import FIB_CLIENT, make_config, wait_for
+
+
+def _openssl(*argv: str) -> None:
+    subprocess.run(["openssl", *argv], check=True, capture_output=True)
+
+
+@pytest.fixture(scope="module")
+def pki(tmp_path_factory):
+    """One CA + node certs 'tls-0', 'tls-1', 'rogue-node'."""
+    root = tmp_path_factory.mktemp("pki")
+    ca_key, ca_crt = root / "ca.key", root / "ca.crt"
+    _openssl(
+        "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", str(ca_key), "-out", str(ca_crt),
+        "-days", "1", "-subj", "/CN=openr-test-ca",
+    )
+    certs = {}
+    for name in ("tls-0", "tls-1", "rogue-node"):
+        key, csr, crt = root / f"{name}.key", root / f"{name}.csr", root / f"{name}.crt"
+        _openssl(
+            "req", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", str(key), "-out", str(csr), "-subj", f"/CN={name}",
+        )
+        _openssl(
+            "x509", "-req", "-in", str(csr), "-CA", str(ca_crt),
+            "-CAkey", str(ca_key), "-CAcreateserial",
+            "-out", str(crt), "-days", "1",
+        )
+        certs[name] = (str(crt), str(key))
+    return str(ca_crt), certs
+
+
+def _tls_conf(pki, name: str, acl: str = "tls-.*") -> TlsConf:
+    ca, certs = pki
+    crt, key = certs[name]
+    return TlsConf(cert_path=crt, key_path=key, ca_path=ca, acl_regex=acl)
+
+
+def _client_cfg(pki, name: str) -> TlsConfig:
+    ca, certs = pki
+    crt, key = certs[name]
+    return TlsConfig(cert_path=crt, key_path=key, ca_path=ca)
+
+
+class TestTlsCtrl:
+    @pytest.fixture
+    def tls_pair(self, pki):
+        fabric = MockIoProvider()
+        ports = (free_port(), free_port())
+        daemons = []
+        for i, port in enumerate(ports):
+            cfg = make_config(f"tls-{i}", ctrl_port=port)
+            cfg.tls_config = _tls_conf(pki, f"tls-{i}")
+            d = OpenrDaemon(
+                cfg,
+                io_provider=fabric.endpoint(f"tls-{i}"),
+                spark_v6_addr="::1",
+            )
+            d.start()
+            daemons.append(d)
+        fabric.connect("tls-0", "t0", "tls-1", "t1")
+        daemons[0].netlink_events_queue.push(LinkEvent("t0", 1, True))
+        daemons[1].netlink_events_queue.push(LinkEvent("t1", 1, True))
+        yield daemons, ports
+        for d in daemons:
+            d.stop()
+
+    def test_kvstore_peering_and_routes_over_mtls(self, tls_pair):
+        """The peer transport rides the same TLS ctrl servers: full
+        convergence proves dual-direction mTLS works."""
+        daemons, ports = tls_pair
+        daemons[1].prefix_manager.advertise_prefixes(
+            PrefixType.LOOPBACK, [PrefixEntry(prefix="fc05::/64")]
+        )
+        assert wait_for(
+            lambda: normalize_prefix("fc05::/64")
+            in daemons[0].fib_agent.unicast.get(FIB_CLIENT, {}),
+            timeout=30,
+        )
+
+    def test_plaintext_client_rejected(self, tls_pair):
+        daemons, ports = tls_pair
+        client = CtrlClient("::1", ports[0], timeout_s=2.0)
+        with pytest.raises((ConnectionError, OSError, RuntimeError)):
+            client.call("getMyNodeName")
+        client.close()
+
+    def test_mtls_client_works_and_breeze(self, pki, tls_pair):
+        daemons, ports = tls_pair
+        client = CtrlClient("::1", ports[0], tls=_client_cfg(pki, "tls-1"))
+        try:
+            assert client.call("getMyNodeName") == "tls-0"
+        finally:
+            client.close()
+        # breeze with TLS flags
+        ca, certs = pki
+        crt, key = certs["tls-1"]
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            rc = breeze.main(
+                ["-p", str(ports[0]), "--tls-cert", crt, "--tls-key", key,
+                 "--tls-ca", ca, "kvstore", "peers"]
+            )
+        assert rc == 0, out.getvalue()
+
+    def test_acl_rejects_wrong_cn(self, pki, tls_pair):
+        """rogue-node's cert is CA-valid but its CN fails the tls-.* ACL —
+        the reference's peer-name allowlist behavior."""
+        daemons, ports = tls_pair
+        client = CtrlClient(
+            "::1", ports[0], timeout_s=2.0, tls=_client_cfg(pki, "rogue-node")
+        )
+        with pytest.raises((ConnectionError, OSError, RuntimeError)):
+            client.call("getMyNodeName")
+        client.close()
+
+
+class TestAclUnit:
+    def test_check_acl(self):
+        cfg = TlsConfig("c", "k", "a", acl_regex="node-[0-9]+")
+        assert check_acl(cfg, "node-12")
+        assert not check_acl(cfg, "node-12x")
+        assert not check_acl(cfg, "intruder")
+        assert not check_acl(cfg, None)
