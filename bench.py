@@ -451,6 +451,11 @@ def main() -> None:
     )
     details["rows"]["allsrc_tile1024_wan100k"] = row_tile
 
+    # --- host subsystems (KvStore merge/dump/flood, Fib, config-store) --
+    from benchmarks import host_subsystems
+
+    details["rows"]["host_subsystems"] = host_subsystems.run_all()
+
     # --- config #4: batched SRLG what-if, 10k variants x 1k nodes -------
     details["rows"]["srlg_whatif_10kx1k"] = bench_srlg_whatif(
         grid, n_variants=10_000, reps=5, cpp_sample=64

@@ -1,0 +1,299 @@
+"""Host-subsystem benchmarks mirroring the reference folly-Benchmark
+harnesses that do NOT involve the compute kernel:
+
+- KvStore CRDT merge throughput   (BM_KvStoreMergeKeyValues,
+  openr/kvstore/tests/KvStoreBenchmark.cpp:190)
+- KvStore full dump               (BM_KvStoreDumpAll, :231)
+- KvStore flooding update         (BM_KvStoreFloodingUpdate, :269 —
+  end-to-end through a live 2-store mesh here)
+- Fib route-programming pipeline  (BM_Fib, openr/fib/tests/
+  FibBenchmark.cpp:214 — DecisionRouteUpdate -> agent programming)
+- PersistentStore write throughput (PersistentStoreBenchmark)
+
+All rows are host-side (no TPU); callable standalone or from bench.py.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import string
+import tempfile
+import time
+from typing import Callable
+
+from openr_tpu.kvstore.kvstore import generate_hash, merge_key_values
+from openr_tpu.types import NextHop, Value
+
+KEY_LEN = 32
+VALUE_LEN = 1024  # kSizeOfValue in the reference harness
+
+
+def _rand_str(rng: random.Random, n: int) -> str:
+    return "".join(rng.choices(string.ascii_lowercase, k=n))
+
+
+def _time_ms(fn: Callable[[], None], reps: int) -> list[float]:
+    out = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        out.append((time.perf_counter() - t0) * 1e3)
+    return out
+
+
+def _spin_until(cond: Callable[[], bool], what: str, timeout_s: float = 30.0) -> None:
+    """Bounded wait: a subsystem regression must fail the bench row with a
+    diagnostic, not hang the benchmark of record forever."""
+    deadline = time.monotonic() + timeout_s
+    while not cond():
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"bench wait timed out: {what}")
+        time.sleep(0.001)
+
+
+def bench_merge_key_values(
+    store_keys: int, update_keys: int, reps: int = 5
+) -> dict:
+    """CRDT merge: `update_keys` newer-version values against a store of
+    `store_keys` (reference: updateKvStore + mergeKeyValues)."""
+    rng = random.Random(7)
+    keys = [_rand_str(rng, KEY_LEN) for _ in range(store_keys)]
+    base = {
+        k: Value(
+            version=1,
+            originator_id="kvStore",
+            value=_rand_str(rng, VALUE_LEN).encode(),
+            ttl_ms=3_600_000,
+        )
+        for k in keys
+    }
+    version = 2
+
+    def run():
+        nonlocal version
+        update = {
+            k: Value(
+                version=version,
+                originator_id="kvStore",
+                value=_rand_str(rng, VALUE_LEN).encode(),
+                ttl_ms=3_600_000,
+            )
+            for k in keys[:update_keys]
+        }
+        merged = merge_key_values(base, update, None)
+        assert len(merged) == update_keys
+        version += 1
+
+    times = _time_ms(run, reps)
+    return {
+        "store_keys": store_keys,
+        "update_keys": update_keys,
+        "ms_min": round(min(times), 3),
+        "keys_per_sec": round(update_keys / (min(times) / 1e3)),
+    }
+
+
+def bench_dump_all(n_keys: int, reps: int = 5) -> dict:
+    """Full dump of a live store (reference: BM_KvStoreDumpAll)."""
+    from openr_tpu.runtime.queue import ReplicateQueue
+    from openr_tpu.kvstore.kvstore import KvStore
+
+    rng = random.Random(11)
+    updates: ReplicateQueue = ReplicateQueue()
+    syncs: ReplicateQueue = ReplicateQueue()
+    store = KvStore("bench", updates, syncs, None)
+    store.run()
+    try:
+        key_vals = {}
+        for _ in range(n_keys):
+            val = Value(
+                version=1,
+                originator_id="bench",
+                value=_rand_str(rng, VALUE_LEN).encode(),
+                ttl_ms=-1,
+            )
+            val.hash = generate_hash(val.version, val.originator_id, val.value)
+            key_vals[_rand_str(rng, KEY_LEN)] = val
+        store.set_key_vals("0", key_vals)
+
+        def run():
+            pub = store.dump_all("0")
+            assert len(pub.key_vals) == n_keys
+
+        times = _time_ms(run, reps)
+    finally:
+        updates.close()
+        syncs.close()
+        store.stop()
+        store.wait_until_stopped(5)
+    return {"n_keys": n_keys, "ms_min": round(min(times), 3)}
+
+
+def bench_flooding_update(n_keys: int, reps: int = 3) -> dict:
+    """End-to-end flooding: set keys on store A, measure until they are
+    merged at peer B over the in-process transport (reference:
+    BM_KvStoreFloodingUpdate, but through a REAL 2-store mesh)."""
+    from openr_tpu.runtime.queue import ReplicateQueue
+    from openr_tpu.kvstore.kvstore import InProcessTransport, KvStore
+    from openr_tpu.types import PeerSpec
+
+    rng = random.Random(13)
+    fab = InProcessTransport()
+    stores = []
+
+    def make(name):
+        updates: ReplicateQueue = ReplicateQueue()
+        syncs: ReplicateQueue = ReplicateQueue()
+        st = KvStore(name, updates, syncs, None, transport=fab.bind(name))
+        fab.register(name, st)
+        st.run()
+        stores.append((st, updates, syncs))
+        return st
+
+    a, b = make("a"), make("b")
+    try:
+        a.add_peers("0", {"b": PeerSpec(peer_addr="b")})
+        b.add_peers("0", {"a": PeerSpec(peer_addr="a")})
+        _spin_until(
+            lambda: all(
+                s is not None and s.name == "INITIALIZED"
+                for s in (
+                    a.get_peer_state("0", "b"),
+                    b.get_peer_state("0", "a"),
+                )
+            ),
+            "kvstore peering",
+        )
+
+        version = 1
+        times = []
+        for _ in range(reps):
+            keys = [_rand_str(rng, KEY_LEN) for _ in range(n_keys)]
+            key_vals = {
+                k: Value(
+                    version=version,
+                    originator_id="a",
+                    value=_rand_str(rng, VALUE_LEN).encode(),
+                    ttl_ms=-1,
+                )
+                for k in keys
+            }
+            t0 = time.perf_counter()
+            a.set_key_vals("0", key_vals)
+            last = keys[-1]
+            _spin_until(
+                lambda: b.get_key_vals("0", [last]).key_vals.get(last)
+                is not None,
+                f"flooding of {n_keys} keys",
+            )
+            times.append((time.perf_counter() - t0) * 1e3)
+            version += 1
+    finally:
+        for st, updates, syncs in stores:
+            updates.close()
+            syncs.close()
+            st.stop()
+        for st, *_ in stores:
+            st.wait_until_stopped(5)
+    return {
+        "n_keys": n_keys,
+        "ms_min": round(min(times), 3),
+        "keys_per_sec": round(n_keys / (min(times) / 1e3)),
+    }
+
+
+def bench_fib_pipeline(n_prefixes: int, reps: int = 3) -> dict:
+    """Route-programming pipeline: DecisionRouteUpdate pushed to a live
+    Fib module until the agent has every route (reference: BM_Fib,
+    FibBenchmark.cpp:214 'wait for the completion of routes update')."""
+    from openr_tpu.decision.rib import DecisionRouteUpdate, RibUnicastEntry
+    from openr_tpu.fib.fib import FIB_CLIENT_OPENR, Fib, MockFibAgent
+    from openr_tpu.runtime.queue import ReplicateQueue
+
+    agent = MockFibAgent()
+    route_updates: ReplicateQueue = ReplicateQueue()
+    fib = Fib("bench", route_updates.get_reader(), agent)
+    fib.run()
+    try:
+        times = []
+        base = 0
+        for _ in range(reps):
+            update = DecisionRouteUpdate()
+            for i in range(n_prefixes):
+                prefix = f"fc00:{base + i:x}::/64"
+                update.unicast_routes_to_update[prefix] = RibUnicastEntry(
+                    prefix=prefix,
+                    nexthops=frozenset(
+                        {
+                            NextHop(
+                                address="fe80::1",
+                                if_name="if0",
+                                neighbor_node_name="peer",
+                            )
+                        }
+                    ),
+                )
+            base += n_prefixes
+            last = f"fc00:{base - 1:x}::/64"
+            t0 = time.perf_counter()
+            route_updates.push(update)
+            _spin_until(
+                lambda: last in agent.unicast.get(FIB_CLIENT_OPENR, {}),
+                f"programming of {n_prefixes} routes",
+            )
+            times.append((time.perf_counter() - t0) * 1e3)
+    finally:
+        route_updates.close()
+        fib.stop()
+        fib.wait_until_stopped(5)
+    return {
+        "n_prefixes": n_prefixes,
+        "ms_min": round(min(times), 3),
+        "routes_per_sec": round(n_prefixes / (min(times) / 1e3)),
+    }
+
+
+def bench_persistent_store(n_writes: int = 1000, reps: int = 3) -> dict:
+    """Durable KV write throughput (reference: PersistentStoreBenchmark)."""
+    from openr_tpu.config_store.persistent_store import PersistentStore
+
+    times = []
+    for _ in range(reps):
+        with tempfile.TemporaryDirectory() as tmp:
+            store = PersistentStore(os.path.join(tmp, "store.bin"))
+            payload = b"x" * 256
+
+            t0 = time.perf_counter()
+            for i in range(n_writes):
+                store.store(f"key-{i % 64}", payload)
+            times.append((time.perf_counter() - t0) * 1e3)
+            store.close()
+    return {
+        "n_writes": n_writes,
+        "ms_min": round(min(times), 3),
+        "writes_per_sec": round(n_writes / (min(times) / 1e3)),
+    }
+
+
+def run_all() -> dict:
+    rows: dict = {}
+    rows["kvstore_merge"] = [
+        bench_merge_key_values(s, u)
+        for s, u in ((10, 10), (1000, 10), (10_000, 100), (10_000, 10_000))
+    ]
+    rows["kvstore_dump_all"] = [bench_dump_all(n) for n in (10, 1000, 10_000)]
+    rows["kvstore_flooding"] = [
+        bench_flooding_update(n) for n in (10, 1000)
+    ]
+    rows["fib_pipeline"] = [
+        bench_fib_pipeline(n) for n in (10, 1000, 9000)
+    ]
+    rows["persistent_store"] = bench_persistent_store()
+    return rows
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(run_all(), indent=1))

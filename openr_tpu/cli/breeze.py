@@ -568,7 +568,9 @@ def main(argv: list[str] | None = None) -> int:
     try:
         args.fn(client, args)
         return 0
-    except ConnectionError as e:
+    except OSError as e:
+        # covers ConnectionError, ssl.SSLError (cert rejected / wrong CA),
+        # and FileNotFoundError for bad cert paths
         print(f"cannot reach ctrl server at [{args.host}]:{args.port}: {e}")
         return 1
     finally:

@@ -170,11 +170,10 @@ class OpenrConfig:
             pac = self.prefix_allocation_config
             if not pac.seed_prefix:
                 raise ConfigError("prefix allocation requires seed_prefix")
-        if self.tls_config is not None and (
-            self.tls_config.cert_path
-            or self.tls_config.key_path
-            or self.tls_config.ca_path
-        ):
+        if self.tls_config is not None:
+            # a present-but-incomplete TLS section must fail loudly — the
+            # daemon silently starting PLAINTEXT when the operator set an
+            # ACL (or a partial cert set) is a security misconfiguration
             tc = self.tls_config
             if not (tc.cert_path and tc.key_path and tc.ca_path):
                 raise ConfigError(

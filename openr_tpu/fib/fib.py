@@ -39,6 +39,7 @@ SYNC_INITIAL_BACKOFF_S = 0.008
 SYNC_MAX_BACKOFF_S = 4.096
 KEEPALIVE_INTERVAL_S = 1.0  # Constants::kKeepAliveCheckInterval
 PERF_DB_SIZE = 10  # reference: kPerfBufferSize
+FIB_CLIENT_OPENR = 786  # thrift::FibClient::OPENR (Platform.thrift:23)
 
 
 class FibAgent(Protocol):
@@ -177,7 +178,7 @@ class Fib(OpenrEventBase):
         *,
         fib_updates_queue: Optional[ReplicateQueue[DecisionRouteUpdate]] = None,
         log_sample_queue: Optional[ReplicateQueue] = None,
-        client_id: int = 786,  # thrift::FibClient::OPENR
+        client_id: int = FIB_CLIENT_OPENR,
         dryrun: bool = False,
         enable_segment_routing: bool = True,
         keepalive_interval_s: float = KEEPALIVE_INTERVAL_S,
