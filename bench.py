@@ -452,9 +452,16 @@ def main() -> None:
     details["rows"]["allsrc_tile1024_wan100k"] = row_tile
 
     # --- host subsystems (KvStore merge/dump/flood, Fib, config-store) --
+    # run_all contains per-row failures; guard the whole call too so a
+    # host-side regression can never stop the TPU kernel rows below
     from benchmarks import host_subsystems
 
-    details["rows"]["host_subsystems"] = host_subsystems.run_all()
+    try:
+        details["rows"]["host_subsystems"] = host_subsystems.run_all()
+    except Exception as exc:
+        details["rows"]["host_subsystems"] = {
+            "error": f"{type(exc).__name__}: {exc}"
+        }
 
     # --- config #4: batched SRLG what-if, 10k variants x 1k nodes -------
     details["rows"]["srlg_whatif_10kx1k"] = bench_srlg_whatif(

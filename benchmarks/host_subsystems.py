@@ -68,24 +68,27 @@ def bench_merge_key_values(
         )
         for k in keys
     }
-    version = 2
-
-    def run():
-        nonlocal version
-        update = {
-            k: Value(
-                version=version,
-                originator_id="kvStore",
-                value=_rand_str(rng, VALUE_LEN).encode(),
-                ttl_ms=3_600_000,
-            )
-            for k in keys[:update_keys]
-        }
+    # updates pre-generated OUTSIDE the timed region — the row measures the
+    # CRDT merge, not random-string generation
+    updates = []
+    for version in range(2, 2 + reps):
+        updates.append(
+            {
+                k: Value(
+                    version=version,
+                    originator_id="kvStore",
+                    value=_rand_str(rng, VALUE_LEN).encode(),
+                    ttl_ms=3_600_000,
+                )
+                for k in keys[:update_keys]
+            }
+        )
+    times = []
+    for update in updates:
+        t0 = time.perf_counter()
         merged = merge_key_values(base, update, None)
+        times.append((time.perf_counter() - t0) * 1e3)
         assert len(merged) == update_keys
-        version += 1
-
-    times = _time_ms(run, reps)
     return {
         "store_keys": store_keys,
         "update_keys": update_keys,
@@ -277,19 +280,30 @@ def bench_persistent_store(n_writes: int = 1000, reps: int = 3) -> dict:
 
 
 def run_all() -> dict:
+    """Per-row error containment: one failing subsystem records an error
+    row instead of aborting the rest of the benchmark of record."""
+
+    def guarded(fn, *args):
+        try:
+            return fn(*args)
+        except Exception as exc:
+            return {"error": f"{type(exc).__name__}: {exc}"}
+
     rows: dict = {}
     rows["kvstore_merge"] = [
-        bench_merge_key_values(s, u)
+        guarded(bench_merge_key_values, s, u)
         for s, u in ((10, 10), (1000, 10), (10_000, 100), (10_000, 10_000))
     ]
-    rows["kvstore_dump_all"] = [bench_dump_all(n) for n in (10, 1000, 10_000)]
+    rows["kvstore_dump_all"] = [
+        guarded(bench_dump_all, n) for n in (10, 1000, 10_000)
+    ]
     rows["kvstore_flooding"] = [
-        bench_flooding_update(n) for n in (10, 1000)
+        guarded(bench_flooding_update, n) for n in (10, 1000)
     ]
     rows["fib_pipeline"] = [
-        bench_fib_pipeline(n) for n in (10, 1000, 9000)
+        guarded(bench_fib_pipeline, n) for n in (10, 1000, 9000)
     ]
-    rows["persistent_store"] = bench_persistent_store()
+    rows["persistent_store"] = guarded(bench_persistent_store)
     return rows
 
 

@@ -17,6 +17,7 @@ import sys
 from typing import Any
 
 from ..ctrl.client import CtrlClient
+from ..fib.fib import FIB_CLIENT_OPENR
 from ..serializer import to_wire
 from ..types import (
     ADJ_MARKER,
@@ -492,7 +493,7 @@ def build_parser() -> argparse.ArgumentParser:
     p = fib.add_parser("validate")
     p.add_argument("--agent-host", default="::1")
     p.add_argument("--agent-port", type=int, default=60100)
-    p.add_argument("--client-id", type=int, default=786)
+    p.add_argument("--client-id", type=int, default=FIB_CLIENT_OPENR)
     p.set_defaults(fn=cmd_fib_validate)
     p = fib.add_parser("routes")
     p.set_defaults(fn=cmd_fib_routes)

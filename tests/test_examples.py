@@ -83,11 +83,11 @@ class TestPollerAndPolicyExamples:
         daemons, ports = tcp_pair
         assert wait_for(
             lambda: "adj:ex-1" in daemons[0].kvstore.dump_all("0").key_vals,
-            timeout=30,
-        )
+            timeout=60,  # spark + TCP peering can be slow under suite load
+        ), sorted(daemons[0].kvstore.dump_all("0").key_vals)
         result = poll([("::1", p) for p in ports])
         tables = list(result.values())
-        assert all(t is not None for t in tables)
+        assert all(t is not None for t in tables), result
         assert "adj:ex-0" in tables[0] and "adj:ex-0" in tables[1]
         # unreachable endpoint reported as None, not an exception
         down = poll([("::1", 1)])

@@ -64,14 +64,18 @@ def _client_cfg(pki, name: str) -> TlsConfig:
     return TlsConfig(cert_path=crt, key_path=key, ca_path=ca)
 
 
-class TestTlsCtrl:
-    @pytest.fixture
-    def tls_pair(self, pki):
-        fabric = MockIoProvider()
-        ports = (free_port(), free_port())
-        daemons = []
+def _make_tls_pair(pki, flood_optimization: bool = False):
+    """Two TLS daemons wired over a mock spark fabric; stops whatever came
+    up even if startup fails part-way."""
+    fabric = MockIoProvider()
+    ports = (free_port(), free_port())
+    daemons = []
+    try:
         for i, port in enumerate(ports):
-            cfg = make_config(f"tls-{i}", ctrl_port=port)
+            cfg = make_config(
+                f"tls-{i}", ctrl_port=port,
+                flood_optimization=flood_optimization,
+            )
             cfg.tls_config = _tls_conf(pki, f"tls-{i}")
             d = OpenrDaemon(
                 cfg,
@@ -83,6 +87,17 @@ class TestTlsCtrl:
         fabric.connect("tls-0", "t0", "tls-1", "t1")
         daemons[0].netlink_events_queue.push(LinkEvent("t0", 1, True))
         daemons[1].netlink_events_queue.push(LinkEvent("t1", 1, True))
+    except Exception:
+        for d in daemons:
+            d.stop()
+        raise
+    return daemons, ports
+
+
+class TestTlsCtrl:
+    @pytest.fixture
+    def tls_pair(self, pki):
+        daemons, ports = _make_tls_pair(pki)
         yield daemons, ports
         for d in daemons:
             d.stop()
@@ -135,6 +150,44 @@ class TestTlsCtrl:
         with pytest.raises((ConnectionError, OSError, RuntimeError)):
             client.call("getMyNodeName")
         client.close()
+
+
+class TestDualOverTcpTls:
+    def test_flood_topology_forms_over_tls_tcp(self, pki):
+        """DUAL messages and flood-topo registration ride the (TLS) ctrl
+        transport between real daemons: the SPT must form and routes must
+        converge — covering processKvStoreDualMessage /
+        updateFloodTopologyChild over the wire (they are in-process
+        everywhere else)."""
+        daemons, ports = _make_tls_pair(pki, flood_optimization=True)
+        try:
+            daemons[1].prefix_manager.advertise_prefixes(
+                PrefixType.LOOPBACK, [PrefixEntry(prefix="fc06::/64")]
+            )
+            assert wait_for(
+                lambda: normalize_prefix("fc06::/64")
+                in daemons[0].fib_agent.unicast.get(FIB_CLIENT, {}),
+                timeout=30,
+            )
+            assert wait_for(
+                lambda: all(
+                    d.kvstore.get_flood_topo("0").flood_root_id == "tls-0"
+                    for d in daemons
+                ),
+                timeout=20,
+            ), [d.kvstore.get_flood_topo("0") for d in daemons]
+            # child registration crossed the wire (async after SPT forms)
+            assert wait_for(
+                lambda: daemons[0]
+                .kvstore.get_flood_topo("0")
+                .infos["tls-0"]
+                .children
+                == ["tls-1"],
+                timeout=20,
+            ), daemons[0].kvstore.get_flood_topo("0")
+        finally:
+            for d in daemons:
+                d.stop()
 
 
 class TestAclUnit:
