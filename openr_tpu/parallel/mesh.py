@@ -45,47 +45,73 @@ def make_mesh(devices=None, batch_axis: int | None = None) -> Mesh:
     return Mesh(dev_array, ("batch", "node"))
 
 
-def spf_step_sharded(mesh: Mesh):
-    """Return a jitted full SPF step (distances + SP-DAG) with explicit
-    in/out shardings over `mesh`.  This is the multi-chip "training step"
-    equivalent: one call does the whole device-side route-compute pass.
+def _step_sharded(mesh: Mesh, masked: bool):
+    """Shared builder for the jitted full SPF step (distances + SP-DAG)
+    with explicit in/out shardings over `mesh` — optionally with a per-row
+    edge-exclusion mask (the what-if / KSP batch axis).
 
     The relaxation runs on the bucketed-ELL tables (ops.batched_sssp_ell);
     the transposed [N, S] distance state is sharded P("node", "batch"), so
     the per-slot row gather all-gathers the node axis over ICI while the
     source batch stays fully parallel."""
     s_batch = NamedSharding(mesh, P("batch"))
+    s_mask_t = NamedSharding(mesh, P(None, "batch"))  # allowed_T [E, S]
     s_dist = NamedSharding(mesh, P("batch", "node"))
     s_dist_t = NamedSharding(mesh, P("node", "batch"))
     s_repl = NamedSharding(mesh, P())
 
-    def step(sources, ell, edge_src, edge_dst, edge_metric, edge_up, node_overloaded):
+    def step(
+        sources,
+        ell,
+        edge_src,
+        edge_dst,
+        edge_metric,
+        edge_up,
+        node_overloaded,
+        extra_mask_t=None,  # [E_cap, S] bool, False = excluded in that row
+    ):
         n_cap = node_overloaded.shape[0]
+        allowed_t = ops.make_relax_allowed_T(
+            sources, edge_src, edge_up, node_overloaded, extra_mask_t
+        )
+        if masked:
+            allowed_t = jax.lax.with_sharding_constraint(allowed_t, s_mask_t)
         dist0_t = jax.lax.with_sharding_constraint(
             ops.make_dist0_T(sources, ell.new_of_old, n_cap), s_dist_t
         )
         dist_t = ops.batched_sssp_ell(
             dist0_t,
             ell,
+            row_allowed_T=allowed_t if masked else None,
             edge_up=edge_up,
             node_overloaded=node_overloaded,
             edge_metric=edge_metric,
         )
         dist_old_t = ops.ell_dist_to_old_T(dist_t, ell)
-        allowed_t = ops.make_relax_allowed_T(
-            sources, edge_src, edge_up, node_overloaded
-        )
         dag = ops.sp_dag_mask_from_T(
             dist_old_t, edge_src, edge_dst, edge_metric, allowed_t
         )
         dist = jax.lax.with_sharding_constraint(dist_old_t.T, s_dist)
         return dist, dag
 
+    common = (s_batch, s_repl, s_repl, s_repl, s_repl, s_repl, s_repl)
+    if masked:
+        return jax.jit(
+            step,
+            in_shardings=common + (s_mask_t,),
+            out_shardings=(s_dist, s_batch),
+        )
     return jax.jit(
-        step,
-        in_shardings=(s_batch, s_repl, s_repl, s_repl, s_repl, s_repl, s_repl),
+        lambda *args: step(*args),
+        in_shardings=common,
         out_shardings=(s_dist, s_batch),
     )
+
+
+def spf_step_sharded(mesh: Mesh):
+    """Jitted unmasked SPF step (all-sources tiles; collective-free on a
+    batch-only mesh)."""
+    return _step_sharded(mesh, masked=False)
 
 
 def sharded_spf_forward(
@@ -103,3 +129,14 @@ def sharded_spf_forward(
     return step(
         sources, ell, edge_src, edge_dst, edge_metric, edge_up, node_overloaded
     )
+
+
+def whatif_step_sharded(mesh: Mesh):
+    """Jitted masked SPF step for failure-scenario fleets: the batch rows
+    are (source, exclusion-mask) variants — SRLG what-if at cluster scale.
+
+    Row independence makes the scenario axis embarrassingly parallel:
+    rows (and their [S, E] masks, sharded P("batch")) never exchange data,
+    so scaling what-if fleets over chips needs no collectives beyond the
+    optional node-axis sharding of the distance state."""
+    return _step_sharded(mesh, masked=True)
