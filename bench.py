@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import json
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -291,6 +292,56 @@ def bench_tilfa(topo, source: int, reps: int) -> dict:
     }
 
 
+def bench_incremental_prefix_updates(
+    n_prefixes: int = 100, reps: int = 50
+) -> dict:
+    """Per-prefix incremental route update latency on a 100-node grid
+    (reference: BM_DecisionGridPrefixUpdates,
+    openr/decision/tests/DecisionBenchmark.cpp:63-76): one advertised
+    prefix changes -> only that route recomputes (the reference's
+    incremental path, Decision.cpp:1903-1912)."""
+    from openr_tpu.decision import LinkState
+    from openr_tpu.decision.prefix_state import PrefixState
+    from openr_tpu.decision.spf_solver import SpfSolver
+    from openr_tpu.types import PrefixEntry, normalize_prefix
+    from openr_tpu.utils.topo import grid_topology
+
+    dbs = grid_topology(10)  # 100 nodes
+    ls = LinkState()
+    for db in dbs:
+        ls.update_adjacency_database(db)
+    ps = PrefixState()
+    # advertisers exclude the solver's own node: a self-originated best
+    # entry correctly yields no route, which is not what this row measures
+    nodes = [db.this_node_name for db in dbs if db.this_node_name != "node-0-0"]
+    for i in range(n_prefixes):
+        ps.update_prefix(
+            nodes[i % len(nodes)], "0", PrefixEntry(prefix=f"fc00:{i:x}::/64")
+        )
+    solver = SpfSolver("node-0-0")
+    solver.build_route_db({"0": ls}, ps)  # warm SPF memo
+
+    times = []
+    for r in range(reps):
+        i = r % n_prefixes
+        prefix = normalize_prefix(f"fc00:{i:x}::/64")
+        node = nodes[(i + 7) % len(nodes)]  # re-home the prefix
+        t0 = time.perf_counter()
+        ps.update_prefix(node, "0", PrefixEntry(prefix=prefix))
+        # incremental path: recompute just this prefix
+        route = solver.create_route_for_prefix_or_get_static_route(
+            {"0": ls}, ps, prefix
+        )
+        times.append((time.perf_counter() - t0) * 1e3)
+        assert route is not None
+    return {
+        "topology": "grid100",
+        "n_prefixes": n_prefixes,
+        "per_prefix_ms_min": round(min(times), 4),
+        "per_prefix_ms_all": [round(t, 3) for t in times],
+    }
+
+
 def bench_reconvergence_grid1024() -> dict:
     """End-to-end Decision reconvergence after an adjacency flap on a
     1k-node grid (reference: BM_DecisionGridAdjUpdates,
@@ -412,14 +463,17 @@ def bench_ksp2_grid1024() -> dict:
     }
 
 
-def _probe_accelerator(timeout_s: float = 120.0, attempts: int = 3) -> bool:
+def _probe_accelerator(
+    timeout_s: float = 120.0, attempts: int = 3
+) -> Optional[str]:
     """Bounded device-availability probe in a subprocess: the shared TPU
     tunnel can wedge in a state where backend init blocks forever, which
-    would turn this benchmark into an infinite hang.  Returns True when
-    jax.devices() comes up within the budget."""
+    would turn this benchmark into an infinite hang.  Returns None when
+    jax.devices() comes up, else a string describing the actual failure."""
     import subprocess
     import sys
 
+    error = "unknown"
     for i in range(attempts):
         # Popen + bounded waits throughout: subprocess.run's timeout path
         # reaps the killed child with an UNBOUNDED wait, which blocks if
@@ -428,12 +482,18 @@ def _probe_accelerator(timeout_s: float = 120.0, attempts: int = 3) -> bool:
         proc = subprocess.Popen(
             [sys.executable, "-c", "import jax; jax.devices()"],
             stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL,
+            stderr=subprocess.PIPE,
         )
+        timed_out = False
         try:
-            if proc.wait(timeout=timeout_s) == 0:
-                return True
+            rc = proc.wait(timeout=timeout_s)
+            if rc == 0:
+                return None
+            stderr = (proc.stderr.read() or b"").decode(errors="replace")
+            error = f"device init exited rc={rc}: {stderr.strip()[-300:]}"
         except subprocess.TimeoutExpired:
+            timed_out = True
+            error = f"device init hang (>{timeout_s:.0f}s)"
             proc.kill()
             try:
                 proc.wait(timeout=5)
@@ -441,23 +501,40 @@ def _probe_accelerator(timeout_s: float = 120.0, attempts: int = 3) -> bool:
                 pass  # D-state child: abandon it rather than block
         if i + 1 < attempts:
             print(
-                f"accelerator probe {i + 1}/{attempts} failed; retrying",
+                f"accelerator probe {i + 1}/{attempts} failed ({error}); "
+                f"retrying",
                 flush=True,
             )
-            time.sleep(10)
-    return False
+            if timed_out:
+                time.sleep(10)  # no backoff value in sleeping on a crash
+    return error
 
 
 def main() -> None:
-    if not _probe_accelerator():
-        error = (
-            "accelerator backend unavailable (device init hang/timeout); "
-            "no measurement taken"
-        )
-        # stamp the details file too so a stale previous run can't be
-        # mistaken for this run's results
+    details: dict = {"rows": {}, "notes": []}
+
+    # --- host-only rows first: they need no device and must survive an
+    # --- accelerator outage (pure-Python solver paths + host subsystems)
+    details["rows"]["incremental_prefix_grid100"] = (
+        bench_incremental_prefix_updates()
+    )
+    # run_all contains per-row failures; guard the whole call too so a
+    # host-side regression can never stop the probe/device rows below
+    from benchmarks import host_subsystems
+
+    try:
+        details["rows"]["host_subsystems"] = host_subsystems.run_all()
+    except Exception as exc:
+        details["rows"]["host_subsystems"] = {
+            "error": f"{type(exc).__name__}: {exc}"
+        }
+
+    probe_error = _probe_accelerator()
+    if probe_error is not None:
+        error = f"accelerator backend unavailable ({probe_error}); device rows skipped"
+        details["error"] = error
         with open("bench_details.json", "w") as f:
-            json.dump({"rows": {}, "notes": [], "error": error}, f, indent=1)
+            json.dump(details, f, indent=1)
         # emit the contract line with a null value rather than hanging
         print(
             json.dumps(
@@ -473,8 +550,6 @@ def main() -> None:
         return
 
     from benchmarks import synthetic
-
-    details: dict = {"rows": {}, "notes": []}
 
     # --- end-to-end reconvergence after adjacency flap ------------------
     details["rows"]["reconverge_flap_grid1024"] = bench_reconvergence_grid1024()
@@ -509,18 +584,6 @@ def main() -> None:
         wan, np.arange(1024, dtype=np.int32), reps=3, cpp_sample=32
     )
     details["rows"]["allsrc_tile1024_wan100k"] = row_tile
-
-    # --- host subsystems (KvStore merge/dump/flood, Fib, config-store) --
-    # run_all contains per-row failures; guard the whole call too so a
-    # host-side regression can never stop the TPU kernel rows below
-    from benchmarks import host_subsystems
-
-    try:
-        details["rows"]["host_subsystems"] = host_subsystems.run_all()
-    except Exception as exc:
-        details["rows"]["host_subsystems"] = {
-            "error": f"{type(exc).__name__}: {exc}"
-        }
 
     # --- config #4: batched SRLG what-if, 10k variants x 1k nodes -------
     details["rows"]["srlg_whatif_10kx1k"] = bench_srlg_whatif(
