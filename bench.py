@@ -292,6 +292,81 @@ def bench_tilfa(topo, source: int, reps: int) -> dict:
     }
 
 
+def bench_decision_cold_start(n_side: int = 10, reps: int = 3) -> dict:
+    """Decision-module cold start: initial adj+prefix publications pushed
+    into a LIVE Decision event base -> debounce -> full route build ->
+    DecisionRouteUpdate emitted (reference: BM_DecisionGridInitialUpdate,
+    DecisionBenchmark.cpp:19-33, which measures the accumulated
+    DECISION_DEBOUNCE -> ROUTE_UPDATE perf-event span)."""
+    from openr_tpu.decision.decision import Decision
+    from openr_tpu.runtime.queue import ReplicateQueue
+    from openr_tpu.serializer import dumps
+    from openr_tpu.types import (
+        PrefixDatabase,
+        PrefixEntry,
+        Publication,
+        Value,
+        adj_key,
+        prefix_key,
+    )
+    from openr_tpu.utils.topo import grid_topology
+
+    dbs = grid_topology(n_side)
+    n_nodes = n_side * n_side
+    kv = {}
+    for db in dbs:
+        kv[adj_key(db.this_node_name)] = Value(
+            version=1, originator_id=db.this_node_name, value=dumps(db)
+        )
+        pdb = PrefixDatabase(
+            this_node_name=db.this_node_name,
+            prefix_entries=[
+                PrefixEntry(prefix=f"fc00:{db.this_node_name[5:].replace('-', ':')}::/96")
+            ],
+        )
+        kv[
+            prefix_key(
+                db.this_node_name, pdb.prefix_entries[0].prefix, "0"
+            )
+        ] = Value(version=1, originator_id=db.this_node_name, value=dumps(pdb))
+
+    times = []
+    for _ in range(reps):
+        kvq: ReplicateQueue = ReplicateQueue()
+        routeq: ReplicateQueue = ReplicateQueue()
+        reader = routeq.get_reader()
+        decision = Decision(
+            dbs[0].this_node_name,
+            kvq.get_reader(),
+            None,
+            routeq,
+            debounce_min_s=0.001,
+            debounce_max_s=0.005,
+        )
+        decision.run()
+        try:
+            t0 = time.perf_counter()
+            kvq.push(Publication(key_vals=dict(kv), area="0"))
+            update = reader.get(timeout=60)
+            elapsed = (time.perf_counter() - t0) * 1e3
+            # routes for every other node's prefix
+            assert (
+                len(update.unicast_routes_to_update) == n_nodes - 1
+            ), len(update.unicast_routes_to_update)
+            times.append(elapsed)
+        finally:
+            kvq.close()
+            routeq.close()
+            decision.stop()
+            decision.wait_until_stopped(5)
+    return {
+        "topology": f"grid{n_nodes}",
+        "n_nodes": n_nodes,
+        "cold_start_ms_min": round(min(times), 3),
+        "cold_start_ms_all": [round(t, 2) for t in times],
+    }
+
+
 def bench_incremental_prefix_updates(
     n_prefixes: int = 100, reps: int = 50
 ) -> dict:
@@ -518,6 +593,7 @@ def main() -> None:
     details["rows"]["incremental_prefix_grid100"] = (
         bench_incremental_prefix_updates()
     )
+    details["rows"]["decision_cold_start_grid100"] = bench_decision_cold_start()
     # run_all contains per-row failures; guard the whole call too so a
     # host-side regression can never stop the probe/device rows below
     from benchmarks import host_subsystems
