@@ -358,3 +358,64 @@ class TestKvStoreClient:
         finally:
             evb.stop()
             evb.wait_until_stopped(5)
+
+
+class TestCrdtConvergence:
+    """Property: merge order must not matter — any permutation of the same
+    update set, applied to any starting subset, converges every replica to
+    the same state (the guarantee the flooding mesh rests on; reference
+    tie-break chain documented at KvStore.cpp:317-340)."""
+
+    @staticmethod
+    def _random_value(rng) -> Value:
+        return Value(
+            version=rng.randint(1, 4),
+            originator_id=rng.choice(["a", "b", "c"]),
+            value=bytes([rng.randint(0, 3)]),
+            ttl_ms=-1,
+            ttl_version=rng.randint(0, 2),
+        )
+
+    def test_order_independence(self):
+        import random
+
+        rng = random.Random(1234)
+        keys = [f"k{i}" for i in range(6)]
+        for trial in range(200):
+            updates = [
+                {
+                    k: self._random_value(rng)
+                    for k in rng.sample(keys, rng.randint(1, len(keys)))
+                }
+                for _ in range(rng.randint(2, 6))
+            ]
+            stores = []
+            for perm in range(3):
+                order = updates[:]
+                rng.shuffle(order)
+                store: dict[str, Value] = {}
+                for upd in order:
+                    # deep-ish copy: merge mutates/absorbs values
+                    merge_key_values(
+                        store,
+                        {
+                            k: Value(
+                                version=v.version,
+                                originator_id=v.originator_id,
+                                value=v.value,
+                                ttl_ms=v.ttl_ms,
+                                ttl_version=v.ttl_version,
+                            )
+                            for k, v in upd.items()
+                        },
+                        None,
+                    )
+                stores.append(store)
+            canon = [
+                {
+                    k: (v.version, v.originator_id, v.value, v.ttl_version)
+                    for k, v in s.items()
+                }
+                for s in stores
+            ]
+            assert canon[0] == canon[1] == canon[2], (trial, canon)
