@@ -361,22 +361,28 @@ class TestKvStoreClient:
 
 
 class TestCrdtConvergence:
-    """Property: merge order must not matter — any permutation of the same
-    update set, applied to any starting subset, converges every replica to
-    the same state (the guarantee the flooding mesh rests on; reference
-    tie-break chain documented at KvStore.cpp:317-340)."""
+    """Property: once every replica has seen the full update set, merge
+    order and any divergent intermediate state must not matter (the
+    guarantee the flooding mesh rests on; reference tie-break chain
+    documented at KvStore.cpp:317-340)."""
 
     @staticmethod
     def _random_value(rng) -> Value:
-        return Value(
+        return v(
             version=rng.randint(1, 4),
-            originator_id=rng.choice(["a", "b", "c"]),
+            originator=rng.choice(["a", "b", "c"]),
             value=bytes([rng.randint(0, 3)]),
-            ttl_ms=-1,
             ttl_version=rng.randint(0, 2),
         )
 
-    def test_order_independence(self):
+    @staticmethod
+    def _canon(store: dict[str, Value]) -> dict:
+        return {
+            k: (val.version, val.originator_id, val.value, val.ttl_version)
+            for k, val in store.items()
+        }
+
+    def test_order_and_start_state_independence(self):
         import random
 
         rng = random.Random(1234)
@@ -390,32 +396,18 @@ class TestCrdtConvergence:
                 for _ in range(rng.randint(2, 6))
             ]
             stores = []
-            for perm in range(3):
+            for _perm in range(3):
+                store: dict[str, Value] = {}
+                # divergent prefix: each replica first sees a random subset
+                # (the pre-full-sync state), then the full set in a random
+                # order — modelling anti-entropy catching a replica up.
+                # Inputs are shared across replicas: merge_key_values never
+                # mutates or retains its input values.
+                prefix = rng.sample(updates, rng.randint(0, len(updates)))
                 order = updates[:]
                 rng.shuffle(order)
-                store: dict[str, Value] = {}
-                for upd in order:
-                    # deep-ish copy: merge mutates/absorbs values
-                    merge_key_values(
-                        store,
-                        {
-                            k: Value(
-                                version=v.version,
-                                originator_id=v.originator_id,
-                                value=v.value,
-                                ttl_ms=v.ttl_ms,
-                                ttl_version=v.ttl_version,
-                            )
-                            for k, v in upd.items()
-                        },
-                        None,
-                    )
+                for upd in list(prefix) + order:
+                    merge_key_values(store, upd, None)
                 stores.append(store)
-            canon = [
-                {
-                    k: (v.version, v.originator_id, v.value, v.ttl_version)
-                    for k, v in s.items()
-                }
-                for s in stores
-            ]
+            canon = [self._canon(s) for s in stores]
             assert canon[0] == canon[1] == canon[2], (trial, canon)
