@@ -81,10 +81,16 @@ class TestPollerAndPolicyExamples:
 
     def test_kvstore_poller(self, tcp_pair):
         daemons, ports = tcp_pair
+        # both directions: each daemon must hold BOTH adj keys before the
+        # poller compares tables (flooding the two ways is not synchronized)
         assert wait_for(
-            lambda: "adj:ex-1" in daemons[0].kvstore.dump_all("0").key_vals,
+            lambda: all(
+                {"adj:ex-0", "adj:ex-1"}
+                <= set(d.kvstore.dump_all("0").key_vals)
+                for d in daemons
+            ),
             timeout=60,  # spark + TCP peering can be slow under suite load
-        ), sorted(daemons[0].kvstore.dump_all("0").key_vals)
+        ), [sorted(d.kvstore.dump_all("0").key_vals) for d in daemons]
         result = poll([("::1", p) for p in ports])
         tables = list(result.values())
         assert all(t is not None for t in tables), result
