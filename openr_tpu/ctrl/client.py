@@ -42,10 +42,11 @@ class CtrlClient:
             (self.host, self.port), timeout=self.timeout_s
         )
         if self.tls is not None:
-            from .tls import client_context
+            from .tls import client_context, verify_peer
 
             try:
                 sock = client_context(self.tls).wrap_socket(sock)
+                verify_peer(self.tls, sock)
             except Exception:
                 sock.close()  # don't leak the raw fd on handshake failure
                 raise
@@ -148,6 +149,14 @@ class TcpKvStoreTransport:
             asyncio.open_connection(host, port, ssl=self._ssl_ctx),
             self.timeout_s,
         )
+        if self._ssl_ctx is not None:
+            from .tls import verify_peer
+
+            try:
+                verify_peer(self.tls, writer.get_extra_info("ssl_object"))
+            except Exception:
+                writer.close()
+                raise
         try:
             request = {"id": 1, "method": method, "params": to_wire(params)}
             writer.write(json.dumps(request).encode() + b"\n")

@@ -22,7 +22,9 @@ class TlsConfig:
     cert_path: str
     key_path: str
     ca_path: str
-    acl_regex: str = ".*"  # client-CN allowlist (server side only)
+    acl_regex: str = ".*"  # peer-CN allowlist (server side, and clients
+    # verify the server's CN against it too — hostname checking is off,
+    # so without this any CA-signed cert could impersonate a ctrl server)
 
 
 def server_context(cfg: TlsConfig) -> ssl.SSLContext:
@@ -58,3 +60,20 @@ def check_acl(cfg: TlsConfig, common_name: Optional[str]) -> bool:
     if common_name is None:
         return False
     return re.fullmatch(cfg.acl_regex, common_name) is not None
+
+
+def verify_peer(cfg: TlsConfig, ssl_object) -> str:
+    """Post-handshake peer-identity check for *clients*.
+
+    With check_hostname off, the CA signature alone says nothing about
+    *which* node we reached — a CA-signed cert the server-side ACL would
+    reject (e.g. a decommissioned or rogue node) could otherwise
+    impersonate a ctrl server / KvStore peer.  Mirrors the server's
+    check_acl gate in the other direction; returns the verified CN.
+    """
+    cn = peer_common_name(ssl_object)
+    if not check_acl(cfg, cn):
+        raise ssl.SSLCertVerificationError(
+            f"server certificate CN {cn!r} rejected by ACL {cfg.acl_regex!r}"
+        )
+    return cn

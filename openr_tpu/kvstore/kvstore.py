@@ -36,6 +36,7 @@ import hashlib
 import heapq
 import logging
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Iterable, Optional, Protocol
 
@@ -73,6 +74,10 @@ DUAL_SEND_RETRY_INITIAL_S = 0.25
 DUAL_SEND_MAX_BACKOFF_S = 8.0
 SPT_REASSERT_INTERVAL_S = 15.0
 SPT_ANTI_ENTROPY_SYNC_S = 60.0
+# bound on queued-but-unsent DUAL messages per peer: an unreachable peer
+# must not accumulate tasks/messages without limit; oldest are dropped
+# (peer_down/peer_up reconciles DUAL state on reconnect anyway)
+DUAL_SEND_BACKLOG_MAX = 64
 
 
 def generate_hash(version: int, originator_id: str, value: Optional[bytes]) -> int:
@@ -420,9 +425,21 @@ class KvStorePeer:
     # request time), leaving a loss window that its deployments paper over
     # with KvStoreClientInternal persist-key refresh; we close it instead.
     pending_flood_keys: set[str] = field(default_factory=set)
-    # FIFO lock serializing DUAL/flood-topo sends to this peer so retries
-    # cannot reorder an older message after a newer one
+    # FIFO lock held by the single outbox drainer so retries cannot
+    # reorder an older message after a newer one
     send_lock: asyncio.Lock = field(default_factory=asyncio.Lock)
+    # DUAL message backlog (bounded at DUAL_SEND_BACKLOG_MAX):
+    # (send_once, failure_counter) entries drained in order
+    outbox: deque = field(default_factory=deque)
+    # topo-set coalescing: (root_id, all_roots) -> latest params.  A
+    # retried older set for a root is superseded by the newest one
+    # (idempotent child add/remove — final state is what matters), so an
+    # unreachable peer holds at most one pending set per root.
+    pending_topo_set: dict = field(default_factory=dict)
+    # set while an anti-entropy reconciliation sync is in flight, so its
+    # completion does not re-fire initialization signaling (see
+    # anti_entropy_sync / process_sync_success)
+    anti_entropy_pending: bool = False
     # whether this peer has ever spoken DUAL to us.  A flood-opt-disabled
     # peer never does, and must keep receiving full-mesh floods even once
     # our SPT is valid — otherwise a mixed-config mesh silently starves it.
@@ -481,26 +498,63 @@ class KvStoreDb:
             log.warning("dual: no peer %s to send messages to", neighbor)
             return False
         self._bump("kvstore.dual.num_pkt_sent")
-        self.store._spawn(self._dual_to_peer(peer, msgs))
+        self._dual_to_peer(peer, msgs)
         return True
 
-    async def _send_reliably(
-        self, peer: KvStorePeer, send_once, failure_counter: str
-    ) -> None:
-        """Reliable+ordered delivery to one peer over the per-request
-        transport: a per-peer FIFO lock prevents a retried older message
-        landing after a newer one, and retries continue (capped backoff)
-        until delivery or until the peer registration is replaced/removed —
-        at which point peer_down/peer_up reconciles DUAL state anyway.
-        Restores the delivery semantics the reference got from its ordered
-        ZMQ peer channel."""
+    async def _drain_peer_outbox(self, peer: KvStorePeer) -> None:
+        """Reliable+ordered delivery of the peer's queued DUAL traffic over
+        the per-request transport: one drainer per peer (FIFO send_lock)
+        prevents a retried older message landing after a newer one; retries
+        continue (capped backoff) until delivery or until the peer
+        registration is replaced/removed — at which point peer_down/peer_up
+        reconciles DUAL state anyway.  Restores the delivery semantics the
+        reference got from its ordered ZMQ peer channel, with a bounded
+        backlog: new work enqueued while draining is picked up by the
+        running drainer, so an unreachable peer holds at most
+        DUAL_SEND_BACKLOG_MAX messages + one pending topo-set per root."""
+        if peer.send_lock.locked():
+            return  # a drainer is running; it will see the new work
         async with peer.send_lock:
             delay = DUAL_SEND_RETRY_INITIAL_S
             failures = 0
             while self.peers.get(peer.name) is peer:
+                if peer.pending_topo_set:
+                    # oldest-first across roots (dict preserves insertion
+                    # order, so an all-roots clear precedes later sets)
+                    topo_key = next(iter(peer.pending_topo_set))
+                    params = peer.pending_topo_set[topo_key]
+
+                    async def send_once(params=params):
+                        await self.store.transport.flood_topo_set(
+                            peer.spec, self.area, params
+                        )
+
+                    def done(topo_key=topo_key, params=params):
+                        # only clear if not superseded while in flight
+                        if peer.pending_topo_set.get(topo_key) is params:
+                            del peer.pending_topo_set[topo_key]
+
+                    failure_counter = "kvstore.dual.num_topo_set_failure"
+                elif peer.outbox:
+                    entry = peer.outbox[0]
+                    send_once, failure_counter = entry
+
+                    def done(entry=entry):
+                        # the in-flight head may have been dropped by a
+                        # backlog overflow while we awaited the send: only
+                        # pop if it is still the head, else the overflow
+                        # already accounted for it and the new head must
+                        # not be silently discarded
+                        if peer.outbox and peer.outbox[0] is entry:
+                            peer.outbox.popleft()
+
+                else:
+                    return
                 try:
                     await send_once()
-                    return
+                    done()
+                    delay = DUAL_SEND_RETRY_INITIAL_S
+                    failures = 0
                 except Exception as exc:
                     self._bump(failure_counter)
                     failures += 1
@@ -514,14 +568,18 @@ class KvStoreDb:
                     await asyncio.sleep(delay)
                     delay = min(delay * 2, DUAL_SEND_MAX_BACKOFF_S)
 
-    async def _dual_to_peer(self, peer: KvStorePeer, msgs) -> None:
-        await self._send_reliably(
-            peer,
-            lambda: self.store.transport.dual_messages(
+    def _dual_to_peer(self, peer: KvStorePeer, msgs) -> None:
+        if len(peer.outbox) >= DUAL_SEND_BACKLOG_MAX:
+            peer.outbox.popleft()  # drop oldest; reconciled on reconnect
+            self._bump("kvstore.dual.num_pkt_backlog_dropped")
+
+        async def send_once():
+            await self.store.transport.dual_messages(
                 peer.spec, self.area, msgs
-            ),
-            "kvstore.dual.num_pkt_send_failure",
-        )
+            )
+
+        peer.outbox.append((send_once, "kvstore.dual.num_pkt_send_failure"))
+        self.store._spawn(self._drain_peer_outbox(peer))
 
     def _process_nexthop_change(
         self, root_id: str, old_nh: Optional[str], new_nh: Optional[str]
@@ -562,16 +620,13 @@ class KvStoreDb:
                 )
 
     def _send_topo_set(self, peer: KvStorePeer, params) -> None:
-        self.store._spawn(self._topo_set_to_peer(peer, params))
-
-    async def _topo_set_to_peer(self, peer: KvStorePeer, params) -> None:
-        await self._send_reliably(
-            peer,
-            lambda: self.store.transport.flood_topo_set(
-                peer.spec, self.area, params
-            ),
-            "kvstore.dual.num_topo_set_failure",
-        )
+        # coalesce by (root, all_roots): latest set wins — child add/remove
+        # is idempotent, so only the final state needs delivering.
+        # all_roots is normalized (it defaults to None) so the
+        # already-pending guard in reassert_spt_children matches.
+        key = (params.root_id, bool(params.all_roots))
+        peer.pending_topo_set[key] = params
+        self.store._spawn(self._drain_peer_outbox(peer))
 
     def reassert_spt_children(self) -> None:
         """Re-register as a child with every current SPT parent.
@@ -592,8 +647,8 @@ class KvStoreDb:
             peer = self.peers.get(nexthop)
             if peer is None:
                 continue
-            if peer.send_lock.locked():
-                continue  # a send is already pending/retrying; don't pile on
+            if (root_id, False) in peer.pending_topo_set:
+                continue  # a set for this root is already pending/retrying
             self._send_topo_set(
                 peer,
                 FloodTopoSetParams(
@@ -628,7 +683,13 @@ class KvStoreDb:
         if parent is not None and parent != self.store.node_id:
             peer = self.peers.get(parent)
             if peer is not None and peer.spec.state == KvStorePeerState.INITIALIZED:
+                # steady-state reconciliation, not an initial sync: flag it
+                # so completion neither re-fires KvStoreSyncEvent (which
+                # gates downstream initialization) nor inflates the
+                # full-sync counters
+                peer.anti_entropy_pending = True
                 peer.spec.state = KvStorePeerState.IDLE
+                self._bump("kvstore.num_anti_entropy_sync")
                 self._schedule_sync(0.0)
         self._anti_entropy_timer = self.store.schedule_timeout(
             SPT_ANTI_ENTROPY_SYNC_S, self.anti_entropy_sync
@@ -968,6 +1029,8 @@ class KvStoreDb:
             existing = self.peers.get(name)
             if existing is not None:
                 existing.spec = spec
+                # a re-added peer's next sync is a genuine initial sync
+                existing.anti_entropy_pending = False
             else:
                 self.peers[name] = KvStorePeer(
                     name=name,
@@ -1081,14 +1144,20 @@ class KvStoreDb:
         if peer.spec.state == KvStorePeerState.IDLE:
             return  # stale response; a new sync round will supersede it
         self.merge_publication(pub, sender_id=peer_name)
-        self._bump("kvstore.thrift.num_full_sync_success")
         peer.spec.state = get_next_state(
             peer.spec.state, KvStorePeerEvent.SYNC_RESP_RCVD
         )
         peer.backoff.report_success()
-        self.store.kvstore_sync_events_queue.push(
-            KvStoreSyncEvent(peer_name, self.area)
-        )
+        if peer.anti_entropy_pending:
+            # periodic reconciliation: don't re-fire initialization
+            # signaling or the initial-sync counters in steady state
+            peer.anti_entropy_pending = False
+            self._bump("kvstore.num_anti_entropy_sync_success")
+        else:
+            self._bump("kvstore.thrift.num_full_sync_success")
+            self.store.kvstore_sync_events_queue.push(
+                KvStoreSyncEvent(peer_name, self.area)
+            )
         self._parallel_sync_limit = min(
             2 * self._parallel_sync_limit, PARALLEL_SYNC_LIMIT_MAX
         )

@@ -19,6 +19,7 @@ framework's FibService boundary.
 from __future__ import annotations
 
 import asyncio
+import errno
 import ipaddress
 import socket
 import struct
@@ -247,15 +248,36 @@ class NetlinkProtocolSocket(OpenrEventBase):
         self.wait_until_running()
         self.run_in_event_base_thread(self._setup).result()
 
+    # reference: kNetlinkSockRecvBuf (NetlinkProtocolSocket.cpp:111-114)
+    # — a large receive buffer so link/addr event storms don't overflow
+    # the socket before the event loop drains it
+    RCVBUF_SIZE = 1 << 20
+
     def _setup(self) -> None:
         sock = socket.socket(socket.AF_NETLINK, socket.SOCK_RAW, NETLINK_ROUTE)
+        # SO_RCVBUFFORCE (=33, not in the socket module) needs
+        # CAP_NET_ADMIN; fall back to the rlimit-capped SO_RCVBUF
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, 33, self.RCVBUF_SIZE)
+        except OSError:
+            sock.setsockopt(
+                socket.SOL_SOCKET, socket.SO_RCVBUF, self.RCVBUF_SIZE
+            )
         sock.bind((0, self._groups))
         sock.setblocking(False)
         self._sock = sock
 
-        # initial state replay: links first, then addresses (LinkMonitor
-        # needs the link before its addresses; reference does the same
-        # ordered bootstrap)
+        self._resync()
+        self._loop.add_reader(sock.fileno(), self._on_readable)
+
+    def _resync(self) -> None:
+        """Full kernel-state replay: links first, then addresses
+        (LinkMonitor needs the link before its addresses; reference does
+        the same ordered bootstrap).  Also the ENOBUFS recovery path —
+        when the kernel drops events the mirror is stale, so re-dump and
+        replay everything (LinkEvent/AddrEvent replays are idempotent
+        downstream, same as the initial bootstrap)."""
+        self.links = {}
         for link in self.get_all_links():
             self.links[link.if_index] = link
             self.netlink_events_queue.push(
@@ -271,14 +293,26 @@ class NetlinkProtocolSocket(OpenrEventBase):
             )
             self._bump("netlink.addrs")
 
-        self._loop.add_reader(sock.fileno(), self._on_readable)
-
     def _on_readable(self) -> None:
         try:
             data = self._sock.recv(65536)
         except BlockingIOError:
             return
-        except OSError:
+        except OSError as exc:
+            if exc.errno == errno.ENOBUFS:
+                # kernel dropped events: the mirror may have missed
+                # link/addr transitions — discard whatever stale
+                # pre-overflow events are still queued (they would
+                # otherwise be applied on top of the fresh dump), then
+                # resynchronize from a full dump (reference enlarges the
+                # buffer and logs; we additionally recover the lost state)
+                self._bump("netlink.enobufs")
+                while True:
+                    try:
+                        self._sock.recv(65536)
+                    except OSError:
+                        break
+                self._resync()
             return
         for msg in parse_messages(data):
             self._bump("netlink.events")

@@ -338,3 +338,76 @@ class TestDualFloodTopo:
         assert ic.infos["a"].cost == 2
         ib = b.get_flood_topo("0")
         assert sorted(ib.flood_peers) == ["a", "c"]
+
+
+class TestUnreliablePeerBounds:
+    def test_dual_backlog_bounded_to_unreachable_peer(self, fabric):
+        """An unreachable peer must not accumulate unbounded parked send
+        tasks/messages: the DUAL backlog is capped (oldest dropped,
+        counted) and topo-sets coalesce to one pending entry per root."""
+        from openr_tpu.kvstore.kvstore import DUAL_SEND_BACKLOG_MAX
+        from openr_tpu.types import FloodTopoSetParams
+
+        fab, make = fabric
+        a = make("a", is_flood_root=True)
+        # "ghost" is registered as a peer but has no store behind it, so
+        # every transport call raises TransportError and retries park
+        a.add_peers("0", {"ghost": spec("ghost")})
+
+        def enqueue_storm():
+            db = a._db("0")
+            peer = db.peers["ghost"]
+            for i in range(DUAL_SEND_BACKLOG_MAX * 3):
+                db._dual_to_peer(peer, object())
+            for i in range(50):
+                db._send_topo_set(
+                    peer,
+                    FloodTopoSetParams(
+                        root_id="a", src_id="a", set_child=bool(i % 2)
+                    ),
+                )
+            return len(peer.outbox), len(peer.pending_topo_set)
+
+        outbox_len, topo_len = a._call(enqueue_storm)
+        assert outbox_len <= DUAL_SEND_BACKLOG_MAX
+        # 50 alternating sets for one root coalesce to a single entry
+        # (possibly + the all-roots clear from add_peers)
+        assert topo_len <= 2
+        dropped = a.get_counters().get(
+            "kvstore.dual.num_pkt_backlog_dropped", 0
+        )
+        assert dropped >= DUAL_SEND_BACKLOG_MAX
+
+    def test_anti_entropy_sync_is_silent_in_steady_state(self, fabric):
+        """Periodic anti-entropy reconciliation must not re-fire
+        KvStoreSyncEvent (downstream initialization signaling) or the
+        initial-sync counters (ADVICE r2: kvstore.py:631)."""
+        fab, make = fabric
+        a = make("a", is_flood_root=True)
+        b = make("b", is_flood_root=False)
+        stores = [a, b]
+        full_mesh(stores)
+        assert wait_for(lambda: all_initialized(stores))
+        assert wait_for(lambda: spt_converged(stores, "a"))
+        sync_reader = b.kvstore_sync_events_queue.get_reader()
+        before_full = b.get_counters().get(
+            "kvstore.thrift.num_full_sync_success", 0
+        )
+        # force the periodic anti-entropy tick now
+        b._call(lambda: b._db("0").anti_entropy_sync())
+        assert wait_for(
+            lambda: b.get_counters().get(
+                "kvstore.num_anti_entropy_sync_success", 0
+            )
+            >= 1
+        ), b.get_counters()
+        # peer is INITIALIZED again...
+        assert wait_for(
+            lambda: b.get_peer_state("0", "a") == KvStorePeerState.INITIALIZED
+        )
+        # ...but no new initial-sync signaling fired
+        assert sync_reader.size() == 0
+        assert (
+            b.get_counters().get("kvstore.thrift.num_full_sync_success", 0)
+            == before_full
+        )

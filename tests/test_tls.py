@@ -140,6 +140,21 @@ class TestTlsCtrl:
             )
         assert rc == 0, out.getvalue()
 
+    def test_client_rejects_server_cn_not_in_acl(self, pki, tls_pair):
+        """Client-side mirror of the ACL (ADVICE r2: tls.py:40): hostname
+        checking is off, so the client verifies the server certificate's
+        CN against the ACL regex after the handshake — a CA-signed but
+        unexpected server identity must be rejected."""
+        import ssl
+
+        daemons, ports = tls_pair
+        cfg = _client_cfg(pki, "tls-1")
+        cfg.acl_regex = "some-other-node"
+        client = CtrlClient("::1", ports[0], timeout_s=2.0, tls=cfg)
+        with pytest.raises(ssl.SSLCertVerificationError):
+            client.call("getMyNodeName")
+        client.close()
+
     def test_acl_rejects_wrong_cn(self, pki, tls_pair):
         """rogue-node's cert is CA-valid but its CN fails the tls-.* ACL —
         the reference's peer-name allowlist behavior."""
