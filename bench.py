@@ -27,16 +27,45 @@ between 0.04ms and ~100ms across sessions); min-over-reps reports the
 hardware's actual capability.  Full per-rep samples land in
 bench_details.json.
 
+Wedge-proofing: the same tunnel can wedge device init or a dispatch
+*forever* (round-2 bench lost every device row to this).  All device rows
+therefore run in a CHILD process (`--device-child`) that appends each
+completed row to a JSONL side file and flushes per row; the parent
+enforces a per-row progress timeout, kills a stalled child, merges
+whatever landed, and respawns the child (skipping finished rows) across
+several attempts spread over the run.  A wedge can now cost at most one
+row per attempt, never the whole bench.
+
 Prints ONE JSON line (headline), writes bench_details.json with all rows.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 from typing import Optional
 
 import numpy as np
+
+DETAILS_PATH = "bench_details.json"
+DEVICE_ROWS_PATH = "bench_device_rows.jsonl"
+# per-row progress timeout for the child: covers device init (~15s),
+# topology build (100k WAN ~60s) and first-compile (~40s) with slack
+ROW_TIMEOUT_S = float(os.environ.get("OPENR_BENCH_ROW_TIMEOUT_S", "900"))
+DEVICE_ATTEMPTS = int(os.environ.get("OPENR_BENCH_DEVICE_ATTEMPTS", "4"))
+RETRY_SLEEP_S = float(os.environ.get("OPENR_BENCH_RETRY_SLEEP_S", "60"))
+
+
+def _flush_details(details: dict) -> None:
+    """Incremental flush so a crash/wedge mid-run never loses prior rows."""
+    tmp = DETAILS_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(details, f, indent=1)
+    os.replace(tmp, DETAILS_PATH)
 
 
 def _time_device(fn, reps: int, warmup: int = 2) -> list[float]:
@@ -122,6 +151,73 @@ def bench_all_sources(topo, sources, reps, cpp_sample=None):
         "cpp_baseline_ms": round(cpp_secs * 1e3 * scale, 3),
         "cpp_sources_measured": len(cpp_sources),
         "cpp_scaled": scale != 1.0,
+    }
+
+
+def _pctl(xs, p: float) -> float:
+    return float(np.percentile(np.asarray(xs, dtype=np.float64), p))
+
+
+def bench_allsrc_full_wan100k(topo, tile: int = 1024) -> dict:
+    """The 100k-node all-sources north star measured end-to-end, not
+    extrapolated: the [100k x 100k] distance matrix (40 GB int32) exceeds
+    single-chip HBM, so all-sources at this scale is tiled by construction
+    — ceil(N/1024) source tiles, ELL graph resident, one device dispatch
+    per tile, distances left on device (the production consumer reduces
+    them to routes; fetching 40 GB to host would measure PCIe, not SPF).
+    Tiles are embarrassingly parallel over the source axis, so the
+    multi-chip projection is total/n_chips (the sharded mesh path in
+    parallel/mesh.py shards exactly this batch axis)."""
+    import jax
+
+    from openr_tpu.ops import sssp as ops
+
+    n = topo.n_nodes
+    n_tiles = -(-n // tile)
+    # static shape for every tile: the ragged tail is padded by repeating
+    # source 0 (extra rows are discarded work, counted honestly below)
+    src_pad = np.zeros(n_tiles * tile, dtype=np.int32)
+    src_pad[:n] = np.arange(n, dtype=np.int32)
+
+    def run_tile(tile_sources):
+        return ops.spf_forward_ell(
+            tile_sources,
+            topo.ell,
+            topo.edge_src,
+            topo.edge_dst,
+            topo.edge_metric,
+            topo.edge_up,
+            topo.node_overloaded,
+        )
+
+    # warm: compile once (all tiles share one program — static shapes)
+    jax.block_until_ready(run_tile(src_pad[:tile]))
+
+    per_tile_ms = []
+    t_start = time.perf_counter()
+    for t in range(n_tiles):
+        t0 = time.perf_counter()
+        jax.block_until_ready(run_tile(src_pad[t * tile : (t + 1) * tile]))
+        per_tile_ms.append((time.perf_counter() - t0) * 1e3)
+    end_to_end_ms = (time.perf_counter() - t_start) * 1e3
+    return {
+        "topology": topo.name,
+        "n_nodes": n,
+        "n_tiles": n_tiles,
+        "tile_sources": tile,
+        "end_to_end_ms": round(end_to_end_ms, 1),
+        "per_tile_ms_min": round(min(per_tile_ms), 3),
+        "per_tile_ms_p50": round(_pctl(per_tile_ms, 50), 3),
+        "per_tile_ms_p95": round(_pctl(per_tile_ms, 95), 3),
+        "projected_ms_8chip": round(end_to_end_ms / 8, 1),
+        "projected_ms_64chip": round(end_to_end_ms / 64, 1),
+        "north_star_target_ms": 50.0,
+        "note": (
+            "single-chip all-sources at 100k is tiled by construction "
+            "(40 GB output > HBM); distances stay on device per tile. "
+            "Projection assumes linear source-axis sharding (validated "
+            "on the virtual mesh in tests/test_parallel_mesh.py)."
+        ),
     }
 
 
@@ -458,7 +554,7 @@ def bench_reconvergence_grid1024() -> dict:
         rdb_d.unicast_routes == rdb_h2.unicast_routes
     )
 
-    def ms(solver, reps=6):
+    def ms(solver, reps):
         out = []
         for _ in range(reps):
             t0 = time.perf_counter()
@@ -466,14 +562,20 @@ def bench_reconvergence_grid1024() -> dict:
             out.append((time.perf_counter() - t0) * 1e3)
         return out
 
-    host_times = ms(host)
-    device_times = ms(device)
+    # >=20 device reps: the claim to retire is about the dispatch-latency
+    # *distribution* (the shared tunnel's bimodal flat tax), so p50/p95
+    # matter here, not just min
+    host_times = ms(host, reps=8)
+    device_times = ms(device, reps=20)
     return {
         "topology": "grid1024",
         "advertised_prefixes": 128,
         "host_ms_min": round(min(host_times), 3),
+        "host_ms_p50": round(_pctl(host_times, 50), 3),
         "host_ms_all": [round(t, 2) for t in host_times],
         "device_ms_min": round(min(device_times), 3),
+        "device_ms_p50": round(_pctl(device_times, 50), 3),
+        "device_ms_p95": round(_pctl(device_times, 95), 3),
         "device_ms_all": [round(t, 2) for t in device_times],
         "device_vs_host": round(min(host_times) / min(device_times), 2),
     }
@@ -538,64 +640,219 @@ def bench_ksp2_grid1024() -> dict:
     }
 
 
-def _probe_accelerator(
-    timeout_s: float = 120.0, attempts: int = 3
-) -> Optional[str]:
-    """Bounded device-availability probe in a subprocess: the shared TPU
-    tunnel can wedge in a state where backend init blocks forever, which
-    would turn this benchmark into an infinite hang.  Returns None when
-    jax.devices() comes up, else a string describing the actual failure."""
-    import subprocess
-    import sys
+class _Topos:
+    """Lazy shared topology cache for the device-row child."""
 
-    error = "unknown"
-    for i in range(attempts):
-        # Popen + bounded waits throughout: subprocess.run's timeout path
-        # reaps the killed child with an UNBOUNDED wait, which blocks if
-        # the child is wedged in uninterruptible device-driver sleep — the
-        # exact failure mode this probe exists to guard against.
-        proc = subprocess.Popen(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            stdout=subprocess.DEVNULL,
-            stderr=subprocess.PIPE,
-        )
-        timed_out = False
-        try:
-            rc = proc.wait(timeout=timeout_s)
-            if rc == 0:
-                return None
-            stderr = (proc.stderr.read() or b"").decode(errors="replace")
-            error = f"device init exited rc={rc}: {stderr.strip()[-300:]}"
-        except subprocess.TimeoutExpired:
-            timed_out = True
-            error = f"device init hang (>{timeout_s:.0f}s)"
-            proc.kill()
+    def __init__(self) -> None:
+        self._cache: dict = {}
+
+    def __getattr__(self, name: str):
+        if name not in self._cache:
+            from benchmarks import synthetic
+
+            if name == "grid":
+                self._cache[name] = synthetic.grid(32)
+            elif name == "fat_tree":
+                self._cache[name] = synthetic.fat_tree()  # 10080, 4-plane
+            elif name == "wan":
+                self._cache[name] = synthetic.wan(100_000)
+            else:
+                raise AttributeError(name)
+        return self._cache[name]
+
+
+def _wan_router_sources(wan) -> np.ndarray:
+    from benchmarks import synthetic
+
+    # router-view: self + every neighbor (the per-router production SPF
+    # set — LFA-free ECMP needs distances from each neighbor)
+    return np.concatenate([[0], synthetic.neighbors_of(wan, 0)]).astype(
+        np.int32
+    )
+
+
+# Device rows, headline first so a wedge loses the least important rows.
+# Each entry: name -> fn(topos) returning the row dict.
+DEVICE_ROWS = {
+    "allsrc_spf_fattree10k": lambda t: bench_all_sources(
+        t.fat_tree, np.arange(t.fat_tree.n_nodes), reps=5, cpp_sample=64
+    ),
+    "allsrc_spf_grid1024": lambda t: bench_all_sources(
+        t.grid, np.arange(t.grid.n_nodes), reps=10
+    ),
+    "router_spf_wan100k": lambda t: bench_all_sources(
+        t.wan, _wan_router_sources(t.wan), reps=5
+    ),
+    "allsrc_tile1024_wan100k": lambda t: bench_all_sources(
+        t.wan, np.arange(1024, dtype=np.int32), reps=3, cpp_sample=32
+    ),
+    "allsrc_full_wan100k": lambda t: bench_allsrc_full_wan100k(t.wan),
+    "srlg_whatif_10kx1k": lambda t: bench_srlg_whatif(
+        t.grid, n_variants=10_000, reps=5, cpp_sample=64
+    ),
+    "tilfa_wan100k": lambda t: bench_tilfa(t.wan, source=0, reps=5),
+    "reconverge_flap_grid1024": lambda t: bench_reconvergence_grid1024(),
+    "ksp2_grid1024": lambda t: bench_ksp2_grid1024(),
+}
+
+DEVICE_NOTES = [
+    "device times include shortest-path-DAG extraction; the C++ "
+    "baseline computes distances only",
+    "min-over-reps: the shared TPU tunnel adds a flat ~100ms penalty "
+    "per dispatch in degraded windows (flips on ~30s timescales, "
+    "independent of program content — measured identical compiled "
+    "programs at 0.04ms and 100ms minutes apart); per-rep samples "
+    "retained above; p50/p95 reported for the latency-sensitive rows",
+]
+
+
+def _device_child(rows_file: str, skip: set[str]) -> None:
+    """Run device rows in order, appending one JSON line per finished row.
+    Runs until done or killed by the parent's progress watchdog."""
+    topos = _Topos()
+    # a child killed mid-write leaves a torn line with no trailing
+    # newline; terminate it so this attempt's first row isn't glued on
+    if os.path.exists(rows_file) and os.path.getsize(rows_file):
+        with open(rows_file, "rb") as f:
+            f.seek(-1, os.SEEK_END)
+            torn = f.read(1) != b"\n"
+        if torn:
+            with open(rows_file, "a") as f:
+                f.write("\n")
+    with open(rows_file, "a") as out:
+        for name, fn in DEVICE_ROWS.items():
+            if name in skip:
+                continue
+            print(f"[device-child] row {name} ...", flush=True)
+            t0 = time.perf_counter()
             try:
-                proc.wait(timeout=5)
-            except subprocess.TimeoutExpired:
-                pass  # D-state child: abandon it rather than block
-        if i + 1 < attempts:
-            print(
-                f"accelerator probe {i + 1}/{attempts} failed ({error}); "
-                f"retrying",
-                flush=True,
+                record = {"row": name, "data": fn(topos)}
+            except Exception as exc:  # a failing row must not kill the rest
+                record = {"row": name, "error": f"{type(exc).__name__}: {exc}"}
+            record["wall_s"] = round(time.perf_counter() - t0, 1)
+            out.write(json.dumps(record) + "\n")
+            out.flush()
+            os.fsync(out.fileno())
+
+
+def _read_device_rows(rows_file: str) -> dict:
+    rows: dict = {}
+    if not os.path.exists(rows_file):
+        return rows
+    with open(rows_file) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn final line from a killed child
+            rows[rec["row"]] = rec
+    return rows
+
+
+def _run_device_rows(details: dict) -> None:
+    """Parent-side orchestration: spawn the device child, watch the rows
+    file for progress, kill on per-row stall, merge, retry with completed
+    rows skipped.  Attempts are spread across the run (sleep between), so
+    a transiently wedged tunnel gets several windows to come back."""
+    if os.path.exists(DEVICE_ROWS_PATH):
+        os.remove(DEVICE_ROWS_PATH)
+    attempt_log: list[str] = []
+    for attempt in range(DEVICE_ATTEMPTS):
+        done = _read_device_rows(DEVICE_ROWS_PATH)
+        # only successful rows are final; errored rows get retried in
+        # later attempt windows (a transient tunnel failure can raise
+        # instead of hanging — both deserve the retry windows)
+        succeeded = [n for n in done if "data" in done[n]]
+        remaining = [n for n in DEVICE_ROWS if n not in succeeded]
+        if not remaining:
+            break
+        if attempt:
+            time.sleep(RETRY_SLEEP_S)
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                os.path.abspath(__file__),
+                "--device-child",
+                "--rows-file",
+                DEVICE_ROWS_PATH,
+                "--skip",
+                ",".join(succeeded),
+            ],
+        )
+        last_size = -1
+        last_progress = time.monotonic()
+        while True:
+            rc = proc.poll()
+            size = (
+                os.path.getsize(DEVICE_ROWS_PATH)
+                if os.path.exists(DEVICE_ROWS_PATH)
+                else 0
             )
-            if timed_out:
-                time.sleep(10)  # no backoff value in sleeping on a crash
-    return error
+            if size != last_size:
+                last_size = size
+                last_progress = time.monotonic()
+                # merge incrementally: a later wedge keeps earlier rows
+                for name, rec in _read_device_rows(DEVICE_ROWS_PATH).items():
+                    details["rows"][name] = rec.get(
+                        "data", {"error": rec.get("error")}
+                    )
+                _flush_details(details)
+            if rc is not None:
+                if rc != 0:
+                    attempt_log.append(f"attempt {attempt + 1}: exit rc={rc}")
+                break
+            if time.monotonic() - last_progress > ROW_TIMEOUT_S:
+                attempt_log.append(
+                    f"attempt {attempt + 1}: no row progress in "
+                    f"{ROW_TIMEOUT_S:.0f}s; killed child"
+                )
+                proc.kill()
+                try:
+                    proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    pass  # D-state child: abandon it rather than block
+                break
+            time.sleep(2)
+    done = _read_device_rows(DEVICE_ROWS_PATH)
+    for name, rec in done.items():
+        details["rows"][name] = rec.get("data", {"error": rec.get("error")})
+    missing = [n for n in DEVICE_ROWS if n not in done]
+    if missing:
+        details["device_rows_missing"] = missing
+    if attempt_log:
+        details["device_attempt_log"] = attempt_log
 
 
 def main() -> None:
-    details: dict = {"rows": {}, "notes": []}
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--device-child", action="store_true")
+    parser.add_argument("--rows-file", default=DEVICE_ROWS_PATH)
+    parser.add_argument("--skip", default="")
+    args = parser.parse_args()
+    if args.device_child:
+        _device_child(
+            args.rows_file, {s for s in args.skip.split(",") if s}
+        )
+        return
+
+    details: dict = {"rows": {}, "notes": list(DEVICE_NOTES)}
 
     # --- host-only rows first: they need no device and must survive an
     # --- accelerator outage (pure-Python solver paths + host subsystems)
-    details["rows"]["incremental_prefix_grid100"] = (
-        bench_incremental_prefix_updates()
-    )
-    details["rows"]["decision_cold_start_grid100"] = bench_decision_cold_start()
+    for name, fn in (
+        ("incremental_prefix_grid100", bench_incremental_prefix_updates),
+        ("decision_cold_start_grid100", bench_decision_cold_start),
+    ):
+        try:
+            details["rows"][name] = fn()
+        except Exception as exc:
+            details["rows"][name] = {"error": f"{type(exc).__name__}: {exc}"}
+        _flush_details(details)
     # run_all contains per-row failures; guard the whole call too so a
-    # host-side regression can never stop the probe/device rows below
+    # host-side regression can never stop the device rows below
     from benchmarks import host_subsystems
 
     try:
@@ -604,14 +861,34 @@ def main() -> None:
         details["rows"]["host_subsystems"] = {
             "error": f"{type(exc).__name__}: {exc}"
         }
+    _flush_details(details)
 
-    probe_error = _probe_accelerator()
-    if probe_error is not None:
-        error = f"accelerator backend unavailable ({probe_error}); device rows skipped"
-        details["error"] = error
-        with open("bench_details.json", "w") as f:
-            json.dump(details, f, indent=1)
-        # emit the contract line with a null value rather than hanging
+    # --- device rows: child-process per-row pipeline (see module doc) ---
+    _run_device_rows(details)
+    _flush_details(details)
+
+    headline = details["rows"].get("allsrc_spf_fattree10k")
+    if headline and "device_ms_min" in headline:
+        print(
+            json.dumps(
+                {
+                    "metric": "allsrc_spf_fattree10k_ms",
+                    "value": headline["device_ms_min"],
+                    "unit": "ms",
+                    "vs_baseline": round(
+                        headline["cpp_baseline_ms"]
+                        / headline["device_ms_min"],
+                        2,
+                    ),
+                }
+            )
+        )
+    else:
+        error = (
+            headline.get("error")
+            if isinstance(headline, dict)
+            else "headline device row did not complete in any attempt window"
+        )
         print(
             json.dumps(
                 {
@@ -623,91 +900,6 @@ def main() -> None:
                 }
             )
         )
-        return
-
-    from benchmarks import synthetic
-
-    # --- end-to-end reconvergence after adjacency flap ------------------
-    details["rows"]["reconverge_flap_grid1024"] = bench_reconvergence_grid1024()
-
-    # --- KSP2 route build (k-shortest edge-disjoint) --------------------
-    details["rows"]["ksp2_grid1024"] = bench_ksp2_grid1024()
-
-    # --- config #1: 1k grid, all sources --------------------------------
-    grid = synthetic.grid(32)
-    row = bench_all_sources(grid, np.arange(grid.n_nodes), reps=10)
-    details["rows"]["allsrc_spf_grid1024"] = row
-
-    # --- config #2 (headline): ~10k fat-tree, all sources ---------------
-    ft = synthetic.fat_tree()  # 10080 switches, 4-plane
-    row_ft = bench_all_sources(
-        ft, np.arange(ft.n_nodes), reps=5, cpp_sample=64
-    )
-    details["rows"]["allsrc_spf_fattree10k"] = row_ft
-
-    # --- config #3: 100k WAN -------------------------------------------
-    wan = synthetic.wan(100_000)
-    # (a) router-view: self + every neighbor (the per-router production
-    #     SPF set — LFA-free ECMP needs distances from each neighbor)
-    router = 0
-    srcs = np.concatenate(
-        [[router], synthetic.neighbors_of(wan, router)]
-    ).astype(np.int32)
-    row_wan = bench_all_sources(wan, srcs, reps=5)
-    details["rows"]["router_spf_wan100k"] = row_wan
-    # (b) 1024-source tile: the all-sources unit of work at 100k
-    row_tile = bench_all_sources(
-        wan, np.arange(1024, dtype=np.int32), reps=3, cpp_sample=32
-    )
-    details["rows"]["allsrc_tile1024_wan100k"] = row_tile
-
-    # --- config #4: batched SRLG what-if, 10k variants x 1k nodes -------
-    details["rows"]["srlg_whatif_10kx1k"] = bench_srlg_whatif(
-        grid, n_variants=10_000, reps=5, cpp_sample=64
-    )
-
-    # --- config #5: TI-LFA backup paths at 100k nodes -------------------
-    details["rows"]["tilfa_wan100k"] = bench_tilfa(wan, source=0, reps=5)
-    n_tiles = -(-wan.n_nodes // 1024)
-    details["notes"].append(
-        f"full all-sources at 100k = {n_tiles} tiles x tile time; the "
-        f"[100k x 100k] distance matrix (40 GB) exceeds single-chip HBM, "
-        f"so all-sources at this scale is tiled by construction"
-    )
-    details["notes"].append(
-        "device times include shortest-path-DAG extraction; the C++ "
-        "baseline computes distances only"
-    )
-    details["notes"].append(
-        "min-over-reps: the shared TPU tunnel adds a flat ~100ms penalty "
-        "per dispatch in degraded windows (flips on ~30s timescales, "
-        "independent of program content — measured identical compiled "
-        "programs at 0.04ms and 100ms minutes apart); per-rep samples "
-        "retained above"
-    )
-    details["notes"].append(
-        "reconverge_flap device row is dominated by that flat per-call "
-        "tax at S=1 (the device program is a single fixed-sweep dispatch "
-        "+ one packed fetch, KB-scale tensors); on an unshared runtime "
-        "the same program's fast-window time is ~2ms"
-    )
-
-    with open("bench_details.json", "w") as f:
-        json.dump(details, f, indent=1)
-
-    headline = details["rows"]["allsrc_spf_fattree10k"]
-    print(
-        json.dumps(
-            {
-                "metric": "allsrc_spf_fattree10k_ms",
-                "value": headline["device_ms_min"],
-                "unit": "ms",
-                "vs_baseline": round(
-                    headline["cpp_baseline_ms"] / headline["device_ms_min"], 2
-                ),
-            }
-        )
-    )
 
 
 if __name__ == "__main__":

@@ -18,6 +18,7 @@ mirroring the reference's plugin seam for drop-in solvers
 from __future__ import annotations
 
 import ipaddress
+import logging
 import weakref
 from typing import Optional, Protocol
 
@@ -38,6 +39,8 @@ from ..types import (
 from .link_state import LinkState, Path, SpfResult
 from .prefix_state import NodeAndArea, PrefixEntries, PrefixState
 from .rib import DecisionRouteDb, RibMplsEntry, RibUnicastEntry
+
+log = logging.getLogger(__name__)
 
 MPLS_LABEL_MIN = 16
 MPLS_LABEL_MAX = (1 << 20) - 1
@@ -466,19 +469,87 @@ class SpfSolver:
         """Reference: selectBestRoutes (Decision.cpp:795-827)."""
         assert prefix_entries
         result = BestRouteSelectionResult()
-        if self.enable_best_route_selection or has_bgp:
-            # PrefixMetrics-ordered selection.  (The reference's separate
-            # BGP MetricVector path, Decision.cpp:865, collapses into the
-            # same ordered compare here — see types.PrefixEntry.)
+        if self.enable_best_route_selection:
+            # PrefixMetrics-ordered selection
             result.all_node_areas = select_best_prefix_metrics(prefix_entries)
             result.best_node_area = select_best_node_area(
                 result.all_node_areas, self.my_node_name
             )
             result.success = True
+        elif has_bgp:
+            return self._run_best_path_selection_bgp(
+                prefix_entries, area_link_states
+            )
         else:
             result.all_node_areas = set(prefix_entries)
             result.best_node_area = min(result.all_node_areas)
             result.success = True
+        return self._maybe_filter_drained_nodes(result, area_link_states)
+
+    def _run_best_path_selection_bgp(
+        self,
+        prefix_entries: PrefixEntries,
+        area_link_states: dict[str, LinkState],
+    ) -> BestRouteSelectionResult:
+        """BGP best-path selection over advertised MetricVectors
+        (reference: runBestPathSelectionBgp, Decision.cpp:865-903):
+        WINNER resets the ECMP set, TIE_WINNER re-points the best entry
+        while keeping prior ties, TIE_LOOSER joins the set; TIE/ERROR
+        abort the route.  The running `best_vector` is the cached
+        comparison target, exactly as the reference's bestVector.
+
+        Deviation for robustness: if no advertiser attached a MetricVector
+        at all, fall back to the PrefixMetrics ordered compare (our
+        PrefixEntry always carries metrics; the reference would throw on
+        the unset thrift optional)."""
+        from .metric_vector import CompareResult, compare_metric_vectors
+
+        result = BestRouteSelectionResult()
+        if all(e.mv is None for e in prefix_entries.values()):
+            result.all_node_areas = select_best_prefix_metrics(prefix_entries)
+            result.best_node_area = select_best_node_area(
+                result.all_node_areas, self.my_node_name
+            )
+            result.success = True
+            return self._maybe_filter_drained_nodes(result, area_link_states)
+
+        best_vector = None
+        # deterministic iteration (the reference walks an unordered_map)
+        for node_area in sorted(prefix_entries):
+            entry = prefix_entries[node_area]
+            if entry.mv is None:
+                # mixed mv/no-mv advertisement is not comparable
+                # (reference: can_throw on the unset optional)
+                log.error(
+                    "BGP entry without metric vector from %s; skipping route",
+                    node_area,
+                )
+                self._bump("decision.no_route_to_prefix")
+                return BestRouteSelectionResult()
+            cmp = (
+                compare_metric_vectors(entry.mv, best_vector)
+                if best_vector is not None
+                else CompareResult.WINNER
+            )
+            if cmp in (CompareResult.TIE, CompareResult.ERROR):
+                log.error(
+                    "%s ordering BGP prefix entries; skipping route",
+                    cmp.value,
+                )
+                self._bump("decision.no_route_to_prefix")
+                return BestRouteSelectionResult()
+            if cmp == CompareResult.WINNER:
+                result.all_node_areas.clear()
+            if cmp in (CompareResult.WINNER, CompareResult.TIE_WINNER):
+                best_vector = entry.mv
+                result.best_node_area = node_area
+            if cmp in (
+                CompareResult.WINNER,
+                CompareResult.TIE_WINNER,
+                CompareResult.TIE_LOOSER,
+            ):
+                result.all_node_areas.add(node_area)
+        result.success = True
         return self._maybe_filter_drained_nodes(result, area_link_states)
 
     def _maybe_filter_drained_nodes(

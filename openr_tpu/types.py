@@ -139,9 +139,41 @@ class PrefixEntry:
     area_stack: tuple[str, ...] = ()
     min_nexthop: Optional[int] = None
     prepend_label: Optional[int] = None
-    # BGP-style metric vector comparison is expressed through `metrics`;
-    # the reference's separate MetricVector path (Decision.cpp:865) collapses
-    # into the same ordered-tuple compare here.
+    # BGP best-path metric vector (reference: Types.thrift:389 `mv`,
+    # compared by MetricVectorUtils::compareMetricVectors, Util.h:479).
+    # When absent on BGP-typed entries, selection falls back to the
+    # PrefixMetrics ordered compare.
+    mv: Optional["MetricVector"] = None
+
+
+class CompareType(enum.IntEnum):
+    """How a metric entity present in only one vector is handled
+    (reference: Types.thrift:235 CompareType)."""
+
+    WIN_IF_PRESENT = 1
+    WIN_IF_NOT_PRESENT = 2
+    IGNORE_IF_NOT_PRESENT = 3
+
+
+@dataclass(slots=True)
+class MetricEntity:
+    """One BGP path attribute in a MetricVector
+    (reference: Types.thrift:237)."""
+
+    type: int
+    priority: int  # higher compares first
+    op: CompareType = CompareType.IGNORE_IF_NOT_PRESENT
+    is_best_path_tie_breaker: bool = False
+    metric: tuple[int, ...] = ()  # lexicographic, larger wins
+
+
+@dataclass(slots=True)
+class MetricVector:
+    """BGP-style best-path metric vector (reference: Types.thrift:273);
+    entries compared in decreasing priority order."""
+
+    version: int = 1
+    metrics: list[MetricEntity] = field(default_factory=list)
 
 
 @dataclass(slots=True)

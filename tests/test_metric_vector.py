@@ -1,0 +1,342 @@
+"""BGP MetricVector comparison + best-path selection conformance.
+
+The compare-chain cases are ported from the reference's
+MetricVectorUtilsTest (openr/common/tests/UtilTest.cpp:780-838) and the
+solver-level cases from DecisionTest's BGP route scenarios
+(openr/decision/tests/DecisionTest.cpp:795-870): a strictly-better vector
+wins the route, identical vectors TIE and the route is skipped, and
+tie-breaker entities keep the looser in the ECMP set while re-pointing
+the best entry.
+"""
+
+from __future__ import annotations
+
+from openr_tpu.decision.metric_vector import (
+    CompareResult,
+    compare_metric_vectors,
+    compare_metrics,
+    is_decisive,
+    negate,
+    result_for_loner,
+)
+from openr_tpu.decision.link_state import LinkState
+from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.decision.prefix_state import PrefixState
+from openr_tpu.types import (
+    CompareType,
+    MetricEntity,
+    MetricVector,
+    PrefixEntry,
+    PrefixType,
+    normalize_prefix,
+)
+from tests.test_spf_solver import adj, build_link_state
+
+
+def ent(
+    type: int,
+    priority: int,
+    metric: tuple[int, ...],
+    op: CompareType = CompareType.WIN_IF_PRESENT,
+    tie_breaker: bool = False,
+) -> MetricEntity:
+    return MetricEntity(
+        type=type,
+        priority=priority,
+        op=op,
+        is_best_path_tie_breaker=tie_breaker,
+        metric=metric,
+    )
+
+
+def five_metrics() -> tuple[MetricVector, MetricVector]:
+    """The UtilTest fixture: 5 entities, type==priority==i, metric [i]."""
+    mk = lambda: MetricVector(
+        version=1, metrics=[ent(i, i, (i,)) for i in range(5)]
+    )
+    return mk(), mk()
+
+
+class TestCompareMetricVectors:
+    """Ported from MetricVectorUtilsTest.compareMetricVectors
+    (UtilTest.cpp:780-838)."""
+
+    def test_empty_vectors_tie(self):
+        assert (
+            compare_metric_vectors(MetricVector(), MetricVector())
+            == CompareResult.TIE
+        )
+
+    def test_version_mismatch_error(self):
+        assert (
+            compare_metric_vectors(
+                MetricVector(version=1), MetricVector(version=2)
+            )
+            == CompareResult.ERROR
+        )
+
+    def test_equal_vectors_tie(self):
+        l, r = five_metrics()
+        assert compare_metric_vectors(l, r) == CompareResult.TIE
+
+    def test_higher_metric_wins(self):
+        l, r = five_metrics()
+        r.metrics[3].metric = (r.metrics[3].metric[0] - 1,)
+        assert compare_metric_vectors(l, r) == CompareResult.WINNER
+        assert compare_metric_vectors(r, l) == CompareResult.LOOSER
+
+    def test_tie_breaker_flag_mismatch_error(self):
+        l, r = five_metrics()
+        r.metrics[3].metric = (r.metrics[3].metric[0] - 1,)
+        r.metrics[3].is_best_path_tie_breaker = True
+        assert compare_metric_vectors(l, r) == CompareResult.ERROR
+
+    def test_tie_breaker_produces_tie_winner(self):
+        l, r = five_metrics()
+        r.metrics[3].metric = (r.metrics[3].metric[0] - 1,)
+        r.metrics[3].is_best_path_tie_breaker = True
+        l.metrics[3].is_best_path_tie_breaker = True
+        assert compare_metric_vectors(l, r) == CompareResult.TIE_WINNER
+        assert compare_metric_vectors(r, l) == CompareResult.TIE_LOOSER
+
+    def test_loner_win_if_present(self):
+        # UtilTest.cpp:818-820: r loses its LOWEST-priority entity (the
+        # reference resize() happens after the in-place priority sort),
+        # keeping the p3 tie-breaker divergence: l's p0 loner is
+        # WIN_IF_PRESENT and decisively overrides the TIE_WINNER
+        l, r = five_metrics()
+        r.metrics[3].metric = (r.metrics[3].metric[0] - 1,)
+        r.metrics[3].is_best_path_tie_breaker = True
+        l.metrics[3].is_best_path_tie_breaker = True
+        r.metrics = r.metrics[1:]  # drop priority-0 entity
+        assert compare_metric_vectors(l, r) == CompareResult.WINNER
+        assert compare_metric_vectors(r, l) == CompareResult.LOOSER
+
+    def test_same_priority_different_type_error(self):
+        # UtilTest.cpp:822-826: the HIGHEST-priority entity's type is
+        # changed — same priority, different type is not comparable
+        l, r = five_metrics()
+        l.metrics[4].type = 99
+        assert compare_metric_vectors(l, r) == CompareResult.ERROR
+        assert compare_metric_vectors(r, l) == CompareResult.ERROR
+
+    def test_loner_win_if_not_present(self):
+        # UtilTest.cpp:828-832: l's lowest-priority loner flips to
+        # WIN_IF_NOT_PRESENT — possessing it now LOSES
+        l, r = five_metrics()
+        r.metrics[3].is_best_path_tie_breaker = True
+        l.metrics[3].is_best_path_tie_breaker = True
+        r.metrics[3].metric = (r.metrics[3].metric[0] - 1,)
+        r.metrics = r.metrics[1:]
+        l.metrics[0].op = CompareType.WIN_IF_NOT_PRESENT
+        assert compare_metric_vectors(l, r) == CompareResult.LOOSER
+        assert compare_metric_vectors(r, l) == CompareResult.WINNER
+
+    def test_loner_ignore_falls_through_to_tie_breaker(self):
+        # UtilTest.cpp:834-837: an IGNORE_IF_NOT_PRESENT loner is
+        # transparent, so the earlier TIE_WINNER from the p3 tie-breaker
+        # carries the result
+        l, r = five_metrics()
+        r.metrics[3].is_best_path_tie_breaker = True
+        l.metrics[3].is_best_path_tie_breaker = True
+        r.metrics[3].metric = (r.metrics[3].metric[0] - 1,)
+        r.metrics = r.metrics[1:]
+        l.metrics[0].op = CompareType.IGNORE_IF_NOT_PRESENT
+        assert compare_metric_vectors(l, r) == CompareResult.TIE_WINNER
+        assert compare_metric_vectors(r, l) == CompareResult.TIE_LOOSER
+
+    def test_metric_length_mismatch_error(self):
+        assert (
+            compare_metrics((1, 2), (1,), tie_breaker=False)
+            == CompareResult.ERROR
+        )
+
+    def test_negate_and_decisive(self):
+        assert negate(CompareResult.WINNER) == CompareResult.LOOSER
+        assert negate(CompareResult.TIE_WINNER) == CompareResult.TIE_LOOSER
+        assert negate(CompareResult.TIE) == CompareResult.TIE
+        assert negate(CompareResult.ERROR) == CompareResult.ERROR
+        assert is_decisive(CompareResult.WINNER)
+        assert is_decisive(CompareResult.ERROR)
+        assert not is_decisive(CompareResult.TIE_WINNER)
+        assert not is_decisive(CompareResult.TIE)
+
+    def test_unsorted_vectors_are_sorted_by_priority(self):
+        # entities listed low-priority-first must still compare by
+        # decreasing priority (sortMetricVector, Util.cpp:989)
+        l = MetricVector(
+            version=1,
+            metrics=[ent(0, 100, (1,)), ent(1, 900, (7,))],
+        )
+        r = MetricVector(
+            version=1,
+            metrics=[ent(1, 900, (7,)), ent(0, 100, (0,))],
+        )
+        assert compare_metric_vectors(l, r) == CompareResult.WINNER
+
+    def test_result_for_loner(self):
+        e = ent(0, 0, (), op=CompareType.WIN_IF_PRESENT)
+        assert result_for_loner(e) == CompareResult.WINNER
+        e.is_best_path_tie_breaker = True
+        assert result_for_loner(e) == CompareResult.TIE_WINNER
+        e.op = CompareType.WIN_IF_NOT_PRESENT
+        assert result_for_loner(e) == CompareResult.TIE_LOOSER
+        e.is_best_path_tie_breaker = False
+        assert result_for_loner(e) == CompareResult.LOOSER
+        e.op = CompareType.IGNORE_IF_NOT_PRESENT
+        assert result_for_loner(e) == CompareResult.TIE
+
+
+PFX = normalize_prefix("fc00:b::/64")
+
+
+def line3() -> LinkState:
+    """1 -- 2 -- 3 (metric 10)."""
+    return build_link_state(
+        {
+            "1": [adj("1", "2")],
+            "2": [adj("2", "1"), adj("2", "3")],
+            "3": [adj("3", "2")],
+        }
+    )
+
+
+def mv_local_pref(pref: int, tie_break_ip: int = 0) -> MetricVector:
+    """LOCAL_PREFERENCE-style entity + optional ROUTER_ID tie-breaker."""
+    metrics = [
+        ent(0, 9000, (pref,), op=CompareType.WIN_IF_PRESENT)
+    ]
+    if tie_break_ip:
+        metrics.append(
+            ent(
+                6,
+                3000,
+                (tie_break_ip,),
+                op=CompareType.WIN_IF_PRESENT,
+                tie_breaker=True,
+            )
+        )
+    return MetricVector(version=1, metrics=metrics)
+
+
+def bgp_entry(mv: MetricVector | None) -> PrefixEntry:
+    return PrefixEntry(prefix=PFX, type=PrefixType.BGP, mv=mv)
+
+
+class TestSolverBgpSelection:
+    """Solver-level BGP selection (DecisionTest.cpp:795-870 scenarios)."""
+
+    def _routes(self, solver_node: str, entries: dict[str, PrefixEntry]):
+        ls = line3()
+        ps = PrefixState()
+        for node, entry in entries.items():
+            ps.update_prefix(node, "0", entry)
+        solver = SpfSolver(solver_node)
+        rdb = solver.build_route_db({"0": ls}, ps)
+        return rdb.unicast_routes
+
+    def test_single_advertiser_wins(self):
+        routes = self._routes("2", {"1": bgp_entry(mv_local_pref(100))})
+        assert PFX in routes
+        assert {nh.address for nh in routes[PFX].nexthops} == {"fe80::1"}
+
+    def test_better_vector_wins(self):
+        routes = self._routes(
+            "2",
+            {
+                "1": bgp_entry(mv_local_pref(100)),
+                "3": bgp_entry(mv_local_pref(200)),
+            },
+        )
+        assert PFX in routes
+        assert {nh.address for nh in routes[PFX].nexthops} == {"fe80::3"}
+
+    def test_identical_vectors_tie_skips_route(self):
+        # "both nodes have same metric vector: we can't determine a best
+        # path" — the reference drops the route (DecisionTest.cpp:849-861)
+        routes = self._routes(
+            "2",
+            {
+                "1": bgp_entry(mv_local_pref(100)),
+                "3": bgp_entry(mv_local_pref(100)),
+            },
+        )
+        assert PFX not in routes
+
+    def test_tie_breaker_keeps_ecmp_set(self):
+        # equal primary metric, ROUTER_ID tie-breaker: node 3 is best but
+        # node 1 stays in the multipath set (TIE_LOOSER semantics)
+        routes = self._routes(
+            "2",
+            {
+                "1": bgp_entry(mv_local_pref(100, tie_break_ip=1)),
+                "3": bgp_entry(mv_local_pref(100, tie_break_ip=3)),
+            },
+        )
+        assert PFX in routes
+        assert {nh.address for nh in routes[PFX].nexthops} == {
+            "fe80::1",
+            "fe80::3",
+        }
+        assert routes[PFX].best_prefix_entry is not None
+
+    def test_version_mismatch_skips_route(self):
+        worse = mv_local_pref(100)
+        worse.version = 2
+        routes = self._routes(
+            "2",
+            {"1": bgp_entry(mv_local_pref(100)), "3": bgp_entry(worse)},
+        )
+        assert PFX not in routes
+
+    def test_no_vectors_falls_back_to_prefix_metrics(self):
+        # our PrefixEntry always carries PrefixMetrics; BGP entries with
+        # no mv anywhere use the ordered-metrics compare (documented
+        # deviation — the reference would throw on the unset optional)
+        routes = self._routes(
+            "2", {"1": bgp_entry(None), "3": bgp_entry(None)}
+        )
+        assert PFX in routes
+        assert {nh.address for nh in routes[PFX].nexthops} == {
+            "fe80::1",
+            "fe80::3",
+        }
+
+    def test_mixed_mv_and_no_mv_skips_route(self):
+        routes = self._routes(
+            "2",
+            {"1": bgp_entry(mv_local_pref(100)), "3": bgp_entry(None)},
+        )
+        assert PFX not in routes
+
+    def test_winner_resets_prior_ties(self):
+        # two tied entries joined the set, then a strict winner arrives:
+        # the set must collapse to the winner only (WINNER clears
+        # allNodeAreas, Decision.cpp:879-880)
+        ls = build_link_state(
+            {
+                "1": [adj("1", "4")],
+                "2": [adj("2", "4")],
+                "3": [adj("3", "4")],
+                "4": [adj("4", "1"), adj("4", "2"), adj("4", "3")],
+            }
+        )
+        ps = PrefixState()
+        ps.update_prefix("1", "0", bgp_entry(mv_local_pref(100, 1)))
+        ps.update_prefix("2", "0", bgp_entry(mv_local_pref(100, 2)))
+        ps.update_prefix("3", "0", bgp_entry(mv_local_pref(200, 3)))
+        solver = SpfSolver("4")
+        rdb = solver.build_route_db({"0": ls}, ps)
+        assert PFX in rdb.unicast_routes
+        assert {nh.address for nh in rdb.unicast_routes[PFX].nexthops} == {
+            "fe80::3"
+        }
+
+    def test_serializer_roundtrip(self):
+        from openr_tpu.serializer import dumps, loads
+
+        entry = bgp_entry(mv_local_pref(100, 7))
+        raw = dumps(entry)
+        back = loads(raw, PrefixEntry)
+        assert back == entry
+        assert dumps(back) == raw
