@@ -81,6 +81,59 @@ def _time_device(fn, reps: int, warmup: int = 2) -> list[float]:
     return out
 
 
+def _time_amortized(make_loop, runs: int, reps: int = 3) -> float:
+    """Per-run ms with the flat per-dispatch tunnel tax divided out.
+
+    The shared TPU tunnel charges a bimodal flat fee per dispatch (~0.04ms
+    or ~100ms depending on the window) that min-over-reps cannot shake when
+    the window stays degraded for minutes.  `make_loop(runs)` must return a
+    jitted thunk executing the kernel `runs` times INSIDE one dispatch
+    (inputs rotated per iteration so XLA cannot hoist the loop body); the
+    per-run time then reflects what the hardware sustains, which is the
+    number production batching achieves (the daemon pipelines many SPF
+    questions per dispatch).  Reported alongside the wall numbers, never
+    instead of them."""
+    import jax
+
+    loop = make_loop(runs)
+    jax.block_until_ready(loop())  # compile + warm
+    single = make_loop(1)
+    jax.block_until_ready(single())
+    # min over each series separately: pairing a fast-window loop() with a
+    # degraded-window single() (or vice versa) would corrupt the
+    # difference; the two mins are each fast-window samples
+    many, one = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(loop())
+        many.append((time.perf_counter() - t0) * 1e3)
+        t0 = time.perf_counter()
+        jax.block_until_ready(single())
+        one.append((time.perf_counter() - t0) * 1e3)
+    return max((min(many) - min(one)) / (runs - 1), 0.0)
+
+
+def _make_kernel_loop(run_i):
+    """Shared scaffolding for the amortized loops: `run_i(i)` returns
+    (dist, dag) for rotated-input iteration i; both outputs are reduced
+    into the fori carry so nothing is dead code."""
+    import jax
+    import jax.numpy as jnp
+
+    def make_loop(runs):
+        @jax.jit
+        def loop():
+            def body(i, acc):
+                dist, dag = run_i(i)
+                return acc + jnp.sum(dist) + jnp.sum(dag.astype(jnp.int32))
+
+            return jax.lax.fori_loop(0, runs, body, jnp.int32(0))
+
+        return loop
+
+    return make_loop
+
+
 def bench_all_sources(topo, sources, reps, cpp_sample=None):
     """Returns dict row: kernel ms (dist + SP-DAG), C++ baseline ms."""
     from benchmarks import cpp_baseline
@@ -126,6 +179,26 @@ def bench_all_sources(topo, sources, reps, cpp_sample=None):
 
     times = _time_device(run, reps)
 
+    # amortized per-run cost (tax-free): R forwards in ONE dispatch with
+    # rotated sources
+    import jax.numpy as jnp
+
+    src_dev = jnp.asarray(sources)
+    amortized = _time_amortized(
+        _make_kernel_loop(
+            lambda i: ops.spf_forward_ell(
+                jnp.roll(src_dev, i),
+                topo.ell,
+                topo.edge_src,
+                topo.edge_dst,
+                topo.edge_metric,
+                topo.edge_up,
+                topo.node_overloaded,
+            )
+        ),
+        runs=4,
+    )
+
     # C++ baseline timing
     cpp_sources = sources
     scale = 1.0
@@ -147,6 +220,7 @@ def bench_all_sources(topo, sources, reps, cpp_sample=None):
         "n_directed_edges": topo.n_edges,
         "n_sources": len(sources),
         "device_ms_min": round(min(times), 3),
+        "device_ms_amortized": round(amortized, 3),
         "device_ms_all": [round(t, 2) for t in times],
         "cpp_baseline_ms": round(cpp_secs * 1e3 * scale, 3),
         "cpp_sources_measured": len(cpp_sources),
@@ -279,6 +353,26 @@ def bench_srlg_whatif(topo, n_variants: int, reps: int, cpp_sample: int) -> dict
 
     times = _time_device(run, reps)
 
+    import jax.numpy as jnp
+
+    mask_dev = jnp.asarray(mask)
+    src_dev = jnp.asarray(sources)
+    amortized = _time_amortized(
+        _make_kernel_loop(
+            lambda i: ops.spf_forward_ell_masked(
+                src_dev,
+                topo.ell,
+                topo.edge_src,
+                topo.edge_dst,
+                topo.edge_metric,
+                topo.edge_up,
+                topo.node_overloaded,
+                jnp.roll(mask_dev, i, axis=0),
+            )
+        ),
+        runs=3,
+    )
+
     # C++ baseline: one full SPF per scenario (sampled + scaled)
     sample = min(cpp_sample, n_variants)
     cpp_secs = 0.0
@@ -303,6 +397,7 @@ def bench_srlg_whatif(topo, n_variants: int, reps: int, cpp_sample: int) -> dict
         "n_variants": n_variants,
         "n_nodes": topo.n_nodes,
         "device_ms_min": round(min(times), 3),
+        "device_ms_amortized": round(amortized, 3),
         "device_ms_all": [round(t, 2) for t in times],
         "cpp_baseline_ms": round(cpp_secs * 1e3 * scale, 3),
         "cpp_variants_measured": sample,
@@ -360,6 +455,26 @@ def bench_tilfa(topo, source: int, reps: int) -> dict:
 
     times = _time_device(run, reps)
 
+    import jax.numpy as jnp
+
+    oe_dev = jnp.asarray(out_edges)
+    amortized = _time_amortized(
+        _make_kernel_loop(
+            lambda i: prot.ti_lfa_backups(
+                np.int32(source),
+                jnp.roll(oe_dev, i),
+                topo.edge_src,
+                topo.edge_dst,
+                topo.edge_metric,
+                topo.edge_up,
+                topo.node_overloaded,
+                rev_full,
+                max_degree=len(out_edges),
+            )
+        ),
+        runs=3,
+    )
+
     # C++ baseline: one full SPF per protected out-edge
     cpp_secs = 0.0
     for d in range(len(out_edges)):
@@ -382,6 +497,7 @@ def bench_tilfa(topo, source: int, reps: int) -> dict:
         "n_nodes": topo.n_nodes,
         "protected_out_edges": int(len(out_edges)),
         "device_ms_min": round(min(times), 3),
+        "device_ms_amortized": round(amortized, 3),
         "device_ms_all": [round(t, 2) for t in times],
         "cpp_baseline_ms": round(cpp_secs * 1e3, 3),
         "cpp_scaled": False,
@@ -703,6 +819,11 @@ DEVICE_NOTES = [
     "independent of program content — measured identical compiled "
     "programs at 0.04ms and 100ms minutes apart); per-rep samples "
     "retained above; p50/p95 reported for the latency-sensitive rows",
+    "device_ms_amortized: per-run time with the flat per-dispatch "
+    "tunnel fee divided out — R rotated-input runs inside ONE "
+    "dispatch, (T_R - T_1)/(R-1).  This is the sustained per-question "
+    "cost production batching achieves; wall numbers (device_ms_min) "
+    "are reported alongside and still include the fee",
 ]
 
 

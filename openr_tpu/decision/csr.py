@@ -87,7 +87,6 @@ class CsrTopology:
     # adaptive fixed-sweep hint for the relax loops (see spf_from); grows
     # by doubling when a run fails to reach the fixed point
     _sweep_hint: int = 16
-
     # -- construction -------------------------------------------------------
 
     @classmethod

@@ -300,6 +300,7 @@ def batched_sssp_ell(
     slot_ok: list = []
     slot_transit: list = []
     slot_w: list = []
+    slot_allowed: list = []
     for bk in ell.buckets:
         if edge_up is None:
             ok = bk.ok
@@ -319,6 +320,19 @@ def batched_sssp_ell(
         slot_ok.append(ok)
         slot_transit.append(transit)
         slot_w.append(w)
+        if row_allowed_T is None:
+            slot_allowed.append(None)
+        else:
+            # HOISTED: the per-row exclusion mask is loop-invariant, so
+            # gather it into slot space ONCE ([R, K, S] per bucket)
+            # instead of per sweep — per-index gather cost dominates the
+            # sweep on TPU, and this halves the masked sweep's gathers
+            r, k = bk.nbr.shape
+            ej = bk.edge_id
+            sa = (ej >= 0)[:, :, None] & jnp.take(
+                row_allowed_T, jnp.maximum(ej, 0).reshape(-1), axis=0
+            ).reshape(r, k, -1)
+            slot_allowed.append(sa)
 
     def relax(dist_T):
         parts = []
@@ -335,13 +349,12 @@ def batched_sssp_ell(
                 allow = slot_ok[b][:, j][:, None] & (
                     slot_transit[b][:, j][:, None] | (d_u == 0)
                 )
-                if row_allowed_T is not None:
-                    ej = bk.edge_id[:, j]
-                    allow &= (ej >= 0)[:, None] & jnp.take(
-                        row_allowed_T, jnp.maximum(ej, 0), axis=0
-                    )
+                if slot_allowed[b] is not None:
+                    allow &= slot_allowed[b][:, j]
                 metric_j = (
-                    jnp.int32(1) if unit_metric else slot_w[b][:, j][:, None]
+                    jnp.int32(1)
+                    if unit_metric
+                    else slot_w[b][:, j][:, None]
                 )
                 cand = jnp.where(allow & (d_u < INF32), d_u + metric_j, INF32)
                 acc = jnp.minimum(acc, cand)
@@ -518,8 +531,49 @@ def first_hops_ell(
     s_dim = sources.shape[0]
 
     # per-edge initial contribution: if the edge leaves the row's source,
-    # its out-slot bit, else 0 (computed lazily per slot below)
+    # its out-slot bit, else 0
     is_src_edge = edge_src[:, None] == sources[None, :]  # [E_cap, S]
+
+    # HOISTED loop invariants (the dag, source membership and slot-bit
+    # tables never change across sweeps): gathering them per sweep used to
+    # triple the sweep's gather count, and per-index gather cost dominates
+    # on TPU.  Precompute per (bucket, slot):
+    #   src_contrib [R, S, W] — OR-term contributed by source-leaving
+    #     dag edges (constant across sweeps)
+    #   use_pred    [R, K, S] — dag edges that forward the predecessor mask
+    src_contrib: list = []
+    use_pred: list = []
+    for bk in ell.buckets:
+        r, k = bk.nbr.shape
+        ej_all = jnp.maximum(bk.edge_id, 0)  # [R, K]
+        on_dag = jnp.take(dag_T, ej_all.reshape(-1), axis=0).reshape(
+            r, k, -1
+        ) & (bk.edge_id >= 0)[:, :, None]  # [R, K, S]
+        from_src = jnp.take(
+            is_src_edge, ej_all.reshape(-1), axis=0
+        ).reshape(r, k, -1)  # [R, K, S]
+        slot = jnp.take(out_slot, ej_all)  # [R, K]
+        bit = jnp.where(
+            slot >= 0,
+            jnp.uint32(1) << (jnp.maximum(slot, 0) % 32).astype(jnp.uint32),
+            jnp.uint32(0),
+        )
+        src_words = jnp.where(
+            (jnp.maximum(slot, 0) // 32)[:, :, None]
+            == jnp.arange(n_words)[None, None, :],
+            bit[:, :, None],
+            jnp.uint32(0),
+        )  # [R, K, W]
+        # OR over slots of the constant source contributions
+        sc = jnp.zeros((r, on_dag.shape[2], n_words), dtype=jnp.uint32)
+        for j in range(k):
+            sc = sc | jnp.where(
+                (on_dag[:, j] & from_src[:, j])[:, :, None],
+                src_words[:, j][:, None, :],
+                jnp.uint32(0),
+            )
+        src_contrib.append(sc)  # [R, S, W]
+        use_pred.append(on_dag & ~from_src)  # [R, K, S]
 
     def relax(nh_T):
         # nh_T: [N_cap, S, W] uint32, permuted rows
@@ -528,32 +582,12 @@ def first_hops_ell(
         for b, bk in enumerate(ell.buckets):
             r, k = bk.nbr.shape
             acc = jax.lax.slice_in_dim(nh_T, lo, lo + r, axis=0)
+            acc = acc | src_contrib[b]
             for j in range(k):
-                ej = jnp.maximum(bk.edge_id[:, j], 0)
-                on_dag = jnp.take(dag_T, ej, axis=0) & (
-                    bk.edge_id[:, j] >= 0
-                )[:, None]  # [R, S]
-                from_src = jnp.take(is_src_edge, ej, axis=0)  # [R, S]
-                # source-edge contribution: the edge's own slot bit
-                slot = jnp.take(out_slot, ej)  # [R]
-                word_idx = jnp.maximum(slot, 0) // 32  # [R]
-                bit = jnp.where(
-                    slot >= 0,
-                    jnp.uint32(1) << (jnp.maximum(slot, 0) % 32).astype(jnp.uint32),
-                    jnp.uint32(0),
-                )  # [R]
-                src_words = jnp.where(
-                    word_idx[:, None] == jnp.arange(n_words)[None, :],
-                    bit[:, None],
-                    jnp.uint32(0),
-                )  # [R, W]
                 pred = jnp.take(nh_T, bk.nbr[:, j], axis=0)  # [R, S, W]
-                contrib = jnp.where(
-                    (on_dag & from_src)[:, :, None],
-                    src_words[:, None, :],
-                    jnp.where(on_dag[:, :, None], pred, jnp.uint32(0)),
+                acc = acc | jnp.where(
+                    use_pred[b][:, j][:, :, None], pred, jnp.uint32(0)
                 )
-                acc = acc | contrib
             parts.append(acc)
             lo += r
         assert lo == n_cap
