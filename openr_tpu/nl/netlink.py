@@ -49,10 +49,22 @@ RTM_GETLINK = 18
 RTM_NEWADDR = 20
 RTM_DELADDR = 21
 RTM_GETADDR = 22
+RTM_NEWROUTE = 24
+RTM_DELROUTE = 25
+RTM_GETROUTE = 26
+RTM_NEWNEIGH = 28
+RTM_DELNEIGH = 29
+RTM_GETNEIGH = 30
+
+NLM_F_CREATE = 0x400
+NLM_F_REPLACE = 0x100
+NLM_F_ACK = 0x04
 
 RTMGRP_LINK = 0x1
 RTMGRP_IPV4_IFADDR = 0x10
 RTMGRP_IPV6_IFADDR = 0x100
+RTMGRP_IPV4_ROUTE = 0x40
+RTMGRP_IPV6_ROUTE = 0x400
 
 IFF_UP = 0x1
 IFF_RUNNING = 0x40
@@ -61,9 +73,37 @@ IFLA_IFNAME = 3
 IFA_ADDRESS = 1
 IFA_LOCAL = 2
 
+# rtattr types for RTM_*ROUTE (linux/rtnetlink.h)
+RTA_DST = 1
+RTA_OIF = 4
+RTA_GATEWAY = 5
+RTA_PRIORITY = 6
+RTA_MULTIPATH = 9
+RTA_TABLE = 15
+RTA_VIA = 18
+RTA_NEWDST = 19
+
+# ndattr types for RTM_*NEIGH (linux/neighbour.h)
+NDA_DST = 1
+NDA_LLADDR = 2
+
+RT_TABLE_MAIN = 254
+RT_SCOPE_UNIVERSE = 0
+RT_SCOPE_LINK = 253
+RTN_UNICAST = 1
+# reference: openr's kernel route protocol id (Platform.thrift FibClient
+# -> protocol mapping, openr/if/Platform.thrift:23; kRouteProtoId 99)
+RTPROT_OPENR = 99
+
+AF_MPLS = 28
+
 _NLMSGHDR = struct.Struct("=IHHII")  # len, type, flags, seq, pid
 _IFINFOMSG = struct.Struct("=BxHiII")  # family, type, index, flags, change
 _IFADDRMSG = struct.Struct("=BBBBi")  # family, prefixlen, flags, scope, index
+_RTMSG = struct.Struct("=BBBBBBBBI")  # family, dst_len, src_len, tos,
+#   table, protocol, scope, type, flags
+_RTNEXTHOP = struct.Struct("=HBBi")  # len, flags, hops (weight-1), ifindex
+_NDMSG = struct.Struct("=BxxxiHBB")  # family, ifindex, state, flags, type
 _RTATTR = struct.Struct("=HH")  # len, type
 _GENMSG = struct.Struct("=Bxxx")  # rtgenmsg: family
 
@@ -111,10 +151,53 @@ class AddrInfo:
 
 
 @dataclass(slots=True)
+class NextHopInfo:
+    """One path of a (possibly multipath) kernel route
+    (reference: openr::fbnl::NextHop, NetlinkTypes.h:48)."""
+
+    gateway: Optional[str] = None  # ip address string
+    if_index: int = 0
+    weight: int = 1  # rtnh_hops + 1
+
+
+@dataclass(slots=True)
+class RouteInfo:
+    """Kernel unicast route (reference: openr::fbnl::Route,
+    NetlinkTypes.h:141; message codec NetlinkRoute.h:41)."""
+
+    dst: str  # CIDR
+    family: int = socket.AF_INET6
+    table: int = RT_TABLE_MAIN
+    protocol: int = RTPROT_OPENR
+    scope: int = RT_SCOPE_UNIVERSE
+    rtype: int = RTN_UNICAST
+    priority: Optional[int] = None
+    nexthops: list[NextHopInfo] = None  # type: ignore[assignment]
+
+    def __post_init__(self) -> None:
+        if self.nexthops is None:
+            self.nexthops = []
+
+
+@dataclass(slots=True)
+class NeighborInfo:
+    """Kernel neighbor entry (reference: NetlinkNeighborMessage,
+    NetlinkRoute.h:255; openr::fbnl::Neighbor)."""
+
+    if_index: int
+    family: int
+    dst: str
+    lladdr: Optional[str] = None
+    state: int = 0
+
+
+@dataclass(slots=True)
 class NetlinkMsg:
     msg_type: int
     link: Optional[LinkInfo] = None
     addr: Optional[AddrInfo] = None
+    route: Optional[RouteInfo] = None
+    neigh: Optional[NeighborInfo] = None
     error: int = 0
 
 
@@ -153,6 +236,165 @@ def _parse_addr(payload: bytes, deleted: bool) -> Optional[AddrInfo]:
     )
 
 
+def _rtattr(atype: int, payload: bytes) -> bytes:
+    alen = _RTATTR.size + len(payload)
+    return _RTATTR.pack(alen, atype) + payload + b"\x00" * (
+        _align4(alen) - alen
+    )
+
+
+def _parse_route(payload: bytes) -> Optional[RouteInfo]:
+    family, dst_len, _src_len, _tos, table, protocol, scope, rtype, _flags = (
+        _RTMSG.unpack_from(payload, 0)
+    )
+    dst_bytes: Optional[bytes] = None
+    gateway: Optional[bytes] = None
+    oif = 0
+    priority: Optional[int] = None
+    multipath: list[NextHopInfo] = []
+    for atype, adata in _walk_rtattrs(payload[_RTMSG.size :]):
+        if atype == RTA_DST:
+            dst_bytes = adata
+        elif atype == RTA_GATEWAY:
+            gateway = adata
+        elif atype == RTA_OIF:
+            (oif,) = struct.unpack("=i", adata)
+        elif atype == RTA_PRIORITY:
+            (priority,) = struct.unpack("=I", adata)
+        elif atype == RTA_TABLE:
+            (table,) = struct.unpack("=I", adata)
+        elif atype == RTA_MULTIPATH:
+            off = 0
+            while off + _RTNEXTHOP.size <= len(adata):
+                rlen, _rflags, hops, ifindex = _RTNEXTHOP.unpack_from(
+                    adata, off
+                )
+                if rlen < _RTNEXTHOP.size:
+                    break
+                gw: Optional[str] = None
+                for satype, sadata in _walk_rtattrs(
+                    adata[off + _RTNEXTHOP.size : off + rlen]
+                ):
+                    if satype == RTA_GATEWAY:
+                        try:
+                            gw = str(ipaddress.ip_address(sadata))
+                        except ValueError:
+                            pass
+                multipath.append(
+                    NextHopInfo(gateway=gw, if_index=ifindex, weight=hops + 1)
+                )
+                off += _align4(rlen)
+    if family not in (socket.AF_INET, socket.AF_INET6):
+        return None  # MPLS/other families: not decoded (encode-only)
+    if dst_bytes is not None:
+        try:
+            ip = ipaddress.ip_address(dst_bytes)
+        except ValueError:
+            return None
+        dst = f"{ip}/{dst_len}"
+    elif dst_len == 0:  # default route carries no RTA_DST
+        dst = "0.0.0.0/0" if family == socket.AF_INET else "::/0"
+    else:
+        return None
+    nexthops = multipath
+    if not nexthops and (gateway is not None or oif):
+        gw = None
+        if gateway is not None:
+            try:
+                gw = str(ipaddress.ip_address(gateway))
+            except ValueError:
+                gw = None
+        nexthops = [NextHopInfo(gateway=gw, if_index=oif)]
+    return RouteInfo(
+        dst=dst,
+        family=family,
+        table=table,
+        protocol=protocol,
+        scope=scope,
+        rtype=rtype,
+        priority=priority,
+        nexthops=nexthops,
+    )
+
+
+def _parse_neigh(payload: bytes) -> Optional[NeighborInfo]:
+    family, ifindex, state, _flags, _ntype = _NDMSG.unpack_from(payload, 0)
+    dst: Optional[str] = None
+    lladdr: Optional[str] = None
+    for atype, adata in _walk_rtattrs(payload[_NDMSG.size :]):
+        if atype == NDA_DST:
+            try:
+                dst = str(ipaddress.ip_address(adata))
+            except ValueError:
+                return None
+        elif atype == NDA_LLADDR:
+            lladdr = ":".join(f"{b:02x}" for b in adata)
+    if dst is None:
+        return None
+    return NeighborInfo(
+        if_index=ifindex, family=family, dst=dst, lladdr=lladdr, state=state
+    )
+
+
+def build_route_request(
+    msg_type: int, seq: int, route: RouteInfo, flags: Optional[int] = None
+) -> bytes:
+    """RTM_NEWROUTE / RTM_DELROUTE with RTA_DST and either a single
+    RTA_GATEWAY/RTA_OIF or an RTA_MULTIPATH of rtnexthop entries
+    (reference: NetlinkRouteMessage::init + addNextHops,
+    openr/nl/NetlinkRoute.cpp:70-310)."""
+    if flags is None:
+        flags = (
+            NLM_F_REQUEST | NLM_F_ACK | NLM_F_CREATE | NLM_F_REPLACE
+            if msg_type == RTM_NEWROUTE
+            else NLM_F_REQUEST | NLM_F_ACK
+        )
+    net = ipaddress.ip_network(route.dst)
+    family = socket.AF_INET if net.version == 4 else socket.AF_INET6
+    attrs = _rtattr(RTA_DST, net.network_address.packed)
+    if route.table >= 256:
+        # rtm_table is 8-bit; larger ids ride the RTA_TABLE attribute
+        # with rtm_table = RT_TABLE_UNSPEC (rtnetlink convention)
+        attrs += _rtattr(RTA_TABLE, struct.pack("=I", route.table))
+    if route.priority is not None:
+        attrs += _rtattr(RTA_PRIORITY, struct.pack("=I", route.priority))
+    if len(route.nexthops) == 1:
+        nh = route.nexthops[0]
+        if nh.gateway is not None:
+            attrs += _rtattr(
+                RTA_GATEWAY, ipaddress.ip_address(nh.gateway).packed
+            )
+        if nh.if_index:
+            attrs += _rtattr(RTA_OIF, struct.pack("=i", nh.if_index))
+    elif len(route.nexthops) > 1:
+        blob = b""
+        for nh in route.nexthops:
+            sub = b""
+            if nh.gateway is not None:
+                sub = _rtattr(
+                    RTA_GATEWAY, ipaddress.ip_address(nh.gateway).packed
+                )
+            rlen = _RTNEXTHOP.size + len(sub)
+            blob += (
+                _RTNEXTHOP.pack(rlen, 0, max(nh.weight, 1) - 1, nh.if_index)
+                + sub
+            )
+        attrs += _rtattr(RTA_MULTIPATH, blob)
+    body = _RTMSG.pack(
+        family,
+        net.prefixlen,
+        0,
+        0,
+        route.table if route.table < 256 else 0,  # 0 + RTA_TABLE above
+        route.protocol,
+        route.scope,
+        route.rtype,
+        0,
+    ) + attrs
+    length = _NLMSGHDR.size + len(body)
+    return _NLMSGHDR.pack(length, msg_type, flags, seq, 0) + body
+
+
 def parse_messages(data: bytes) -> Iterator[NetlinkMsg]:
     """Parse a datagram of (possibly multipart) netlink messages."""
     off = 0
@@ -172,6 +414,14 @@ def parse_messages(data: bytes) -> Iterator[NetlinkMsg]:
             addr = _parse_addr(payload, deleted=mtype == RTM_DELADDR)
             if addr is not None:
                 yield NetlinkMsg(msg_type=mtype, addr=addr)
+        elif mtype in (RTM_NEWROUTE, RTM_DELROUTE):
+            route = _parse_route(payload)
+            if route is not None:
+                yield NetlinkMsg(msg_type=mtype, route=route)
+        elif mtype in (RTM_NEWNEIGH, RTM_DELNEIGH):
+            neigh = _parse_neigh(payload)
+            if neigh is not None:
+                yield NetlinkMsg(msg_type=mtype, neigh=neigh)
         off += _align4(mlen)
 
 
@@ -195,13 +445,14 @@ class NetlinkProtocolSocket(OpenrEventBase):
 
     def __init__(
         self,
-        netlink_events_queue: ReplicateQueue,
+        netlink_events_queue: Optional[ReplicateQueue] = None,
         groups: int = RTMGRP_LINK | RTMGRP_IPV4_IFADDR | RTMGRP_IPV6_IFADDR,
     ) -> None:
         super().__init__(name="netlink")
         self.netlink_events_queue = netlink_events_queue
         self._groups = groups
         self._sock: Optional[socket.socket] = None
+        self._req_sock: Optional[socket.socket] = None
         self._seq = 0
         self.links: dict[int, LinkInfo] = {}  # kernel mirror by ifindex
         self.counters: dict[str, int] = {}
@@ -240,6 +491,84 @@ class NetlinkProtocolSocket(OpenrEventBase):
 
     def get_all_addresses(self) -> list[AddrInfo]:
         return [m.addr for m in self._dump(RTM_GETADDR) if m.addr]
+
+    def get_all_neighbors(self) -> list[NeighborInfo]:
+        """Reference: NetlinkProtocolSocket::getAllNeighbors
+        (NetlinkProtocolSocket.h:96 surface)."""
+        return [m.neigh for m in self._dump(RTM_GETNEIGH) if m.neigh]
+
+    def get_routes(
+        self,
+        protocol: Optional[int] = RTPROT_OPENR,
+        table: Optional[int] = RT_TABLE_MAIN,
+    ) -> list[RouteInfo]:
+        """Full route-table dump, filtered client-side by protocol/table
+        (reference: NetlinkProtocolSocket::getRoutes / getAllRoutes;
+        getRouteTableByClient reads back exactly the openr-protocol
+        routes, openr/platform/NetlinkFibHandler.h)."""
+        out = []
+        for m in self._dump(RTM_GETROUTE):
+            r = m.route
+            if r is None:
+                continue
+            if protocol is not None and r.protocol != protocol:
+                continue
+            if table is not None and r.table != table:
+                continue
+            out.append(r)
+        return out
+
+    # -- synchronous route programming (reference: NetlinkRouteMessage
+    # -- add/delete with ACK, openr/nl/NetlinkRoute.cpp) -------------------
+
+    def _request_sock(self) -> socket.socket:
+        """Persistent request socket for route transactions (the
+        reference keeps one request fd too; a 1k-route sync must not pay
+        1k socket setup/teardown cycles)."""
+        if self._req_sock is None:
+            sock = socket.socket(
+                socket.AF_NETLINK, socket.SOCK_RAW, NETLINK_ROUTE
+            )
+            sock.bind((0, 0))
+            sock.settimeout(5.0)
+            self._req_sock = sock
+        return self._req_sock
+
+    def _transact(self, request: bytes) -> None:
+        """Send one ACK-flagged request and wait for its NLMSG_ERROR
+        (error 0 == ACK); raises NetlinkError on kernel rejection."""
+        sock = self._request_sock()
+        try:
+            sock.send(request)
+            while True:
+                data = sock.recv(65536)
+                for msg in parse_messages(data):
+                    if msg.msg_type == NLMSG_ERROR:
+                        if msg.error:
+                            raise NetlinkError(
+                                msg.error, "netlink route request rejected"
+                            )
+                        return
+        except NetlinkError:
+            raise  # clean kernel rejection: the socket is still in sync
+        except OSError:
+            # timeout/desync: drop the socket so the next transact starts
+            # from a clean fd + sequence space
+            try:
+                sock.close()
+            finally:
+                self._req_sock = None
+            raise
+
+    def add_route(self, route: RouteInfo) -> None:
+        self._seq += 1
+        self._transact(build_route_request(RTM_NEWROUTE, self._seq, route))
+        self._bump("netlink.routes_added")
+
+    def del_route(self, route: RouteInfo) -> None:
+        self._seq += 1
+        self._transact(build_route_request(RTM_DELROUTE, self._seq, route))
+        self._bump("netlink.routes_deleted")
 
     # -- event subscription --------------------------------------------------
 
@@ -280,17 +609,13 @@ class NetlinkProtocolSocket(OpenrEventBase):
         self.links = {}
         for link in self.get_all_links():
             self.links[link.if_index] = link
-            self.netlink_events_queue.push(
-                LinkEvent(link.if_name, link.if_index, link.is_up)
-            )
+            self._push(LinkEvent(link.if_name, link.if_index, link.is_up))
             self._bump("netlink.links")
         for addr in self.get_all_addresses():
             link = self.links.get(addr.if_index)
             if link is None:
                 continue
-            self.netlink_events_queue.push(
-                AddrEvent(link.if_name, addr.prefix, addr.is_valid)
-            )
+            self._push(AddrEvent(link.if_name, addr.prefix, addr.is_valid))
             self._bump("netlink.addrs")
 
     def _on_readable(self) -> None:
@@ -320,23 +645,25 @@ class NetlinkProtocolSocket(OpenrEventBase):
                 link = msg.link
                 if msg.msg_type == RTM_DELLINK:
                     self.links.pop(link.if_index, None)
-                    self.netlink_events_queue.push(
-                        LinkEvent(link.if_name, link.if_index, False)
-                    )
+                    self._push(LinkEvent(link.if_name, link.if_index, False))
                 else:
                     prev = self.links.get(link.if_index)
                     self.links[link.if_index] = link
                     if prev is None or prev.is_up != link.is_up:
-                        self.netlink_events_queue.push(
+                        self._push(
                             LinkEvent(link.if_name, link.if_index, link.is_up)
                         )
             elif msg.addr is not None:
                 link = self.links.get(msg.addr.if_index)
                 if link is None:
                     continue
-                self.netlink_events_queue.push(
+                self._push(
                     AddrEvent(link.if_name, msg.addr.prefix, msg.addr.is_valid)
                 )
+
+    def _push(self, event) -> None:
+        if self.netlink_events_queue is not None:
+            self.netlink_events_queue.push(event)
 
     def stop(self) -> None:  # type: ignore[override]
         if self._sock is not None and self._loop is not None:

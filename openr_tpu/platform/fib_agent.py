@@ -4,12 +4,21 @@ The reference ships `platform_linux` (LinuxPlatformMain.cpp), a separate
 process whose NetlinkFibHandler (openr/platform/NetlinkFibHandler.h)
 implements the thrift FibService (openr/if/Platform.thrift:71-160) and
 programs the Linux kernel via netlink.  The TPU-native equivalent keeps
-the same process boundary and API surface but programs a simulated route
-table (this image has no netlink/kernel surface): the daemon's Fib module
-talks to it over the NDJSON-RPC wire transport, and `breeze fib validate`
-audits daemon state against the agent's table.
+the same process boundary and API surface with two backends:
 
-Run standalone:  python -m openr_tpu.platform.fib_agent --port 60100
+- SimulatedRouteTable (default): in-process table for clusterless tests
+  (the MockNetlinkFibHandler pattern).
+- KernelRouteTable (`--kernel`): programs REAL kernel routes through the
+  from-scratch rtnetlink codec (openr_tpu.nl.netlink RTM_NEWROUTE /
+  DELROUTE incl. RTA_MULTIPATH), with the reference's client->protocol
+  mapping (Platform.thrift:58 clientIdtoProtocolId) and read-back via
+  protocol-filtered route dumps (getRouteTableByClient,
+  openr/platform/NetlinkFibHandler.h).  Requires CAP_NET_ADMIN.
+
+The daemon's Fib module talks to it over the NDJSON-RPC wire transport,
+and `breeze fib validate` audits daemon state against the agent's table.
+
+Run standalone:  python -m openr_tpu.platform.fib_agent --port 60100 [--kernel]
 """
 
 from __future__ import annotations
@@ -24,7 +33,7 @@ import time
 from typing import Any, Iterable, Optional
 
 from ..serializer import from_wire, to_wire
-from ..types import MplsRoute, UnicastRoute
+from ..types import MplsRoute, NextHop, UnicastRoute
 
 log = logging.getLogger(__name__)
 
@@ -114,13 +123,209 @@ class SimulatedRouteTable:
             return dict(self.counters)
 
 
+# reference: Platform.thrift:58 — kernel route protocol per FibService
+# client (rtnetlink rtm_protocol must be < 254)
+CLIENT_ID_TO_PROTOCOL = {786: 99, 0: 253}
+DEFAULT_PROTOCOL = 99
+
+
+class KernelRouteTable:
+    """FibService backend programming REAL kernel routes
+    (reference: NetlinkFibHandler, openr/platform/NetlinkFibHandler.h).
+
+    Unicast v4/v6 incl. multipath ride RTM_NEWROUTE/DELROUTE through the
+    nl codec; per-client separation uses the kernel protocol id exactly
+    like the reference (clientIdtoProtocolId).  MPLS label routes are
+    tracked in-process only (kernels in most deployments need the
+    mpls_router module + sysctl; the reference gates the same way) —
+    get_mpls_route_table_by_client stays truthful to what was requested.
+    """
+
+    def __init__(self, table_id: Optional[int] = None) -> None:
+        from ..nl.netlink import NetlinkProtocolSocket, RT_TABLE_MAIN
+
+        self._lock = threading.Lock()
+        self._alive_since = int(time.time() * 1000)
+        self.nl = NetlinkProtocolSocket()
+        self.table_id = RT_TABLE_MAIN if table_id is None else table_id
+        self.mpls: dict[int, dict[int, MplsRoute]] = {}
+        self.counters: dict[str, int] = {}
+        self._if_index: dict[str, int] = {}
+
+    def _bump(self, counter: str, n: int = 1) -> None:
+        self.counters[counter] = self.counters.get(counter, 0) + n
+
+    @staticmethod
+    def _protocol(client_id: int) -> int:
+        proto = CLIENT_ID_TO_PROTOCOL.get(client_id)
+        if proto is None:
+            # the reference rejects unknown clients (getProtocol ->
+            # ENOTSUPPORTED); silently aliasing them onto openr's
+            # protocol would let a stray client wipe openr's FIB
+            raise ValueError(f"unknown FibService client id {client_id}")
+        return proto
+
+    def _ifindex(self, if_name: Optional[str]) -> int:
+        if not if_name:
+            return 0
+        idx = self._if_index.get(if_name)
+        if idx is None:
+            self._if_index = {
+                l.if_name: l.if_index for l in self.nl.get_all_links()
+            }
+            # negative-cache misses: a vanished interface must not cost a
+            # full link dump per route
+            idx = self._if_index.setdefault(if_name, 0)
+        return idx
+
+    def _to_route_info(self, client_id: int, route: UnicastRoute):
+        from ..nl.netlink import NextHopInfo, RouteInfo
+
+        nexthops = [
+            NextHopInfo(
+                gateway=nh.address or None,
+                if_index=self._ifindex(nh.if_name),
+                weight=max(nh.weight, 1),
+            )
+            for nh in route.next_hops
+        ]
+        return RouteInfo(
+            dst=route.dest,
+            table=self.table_id,
+            protocol=self._protocol(client_id),
+            nexthops=nexthops,
+        )
+
+    # -- FibService API ------------------------------------------------------
+
+    def add_unicast_routes(
+        self, client_id: int, routes: list[UnicastRoute]
+    ) -> None:
+        with self._lock:
+            for route in routes:
+                self.nl.add_route(self._to_route_info(client_id, route))
+            self._bump("fibagent.kernel.add_unicast", len(routes))
+
+    def delete_unicast_routes(
+        self, client_id: int, prefixes: list[str]
+    ) -> None:
+        from ..nl.netlink import NetlinkError, RouteInfo
+
+        with self._lock:
+            for prefix in prefixes:
+                try:
+                    self.nl.del_route(
+                        RouteInfo(
+                            dst=prefix,
+                            table=self.table_id,
+                            protocol=self._protocol(client_id),
+                        )
+                    )
+                except NetlinkError as exc:
+                    import errno as _errno
+
+                    if exc.errno != _errno.ESRCH:  # already gone: idempotent
+                        raise
+            self._bump("fibagent.kernel.del_unicast", len(prefixes))
+
+    def add_mpls_routes(self, client_id: int, routes: list[MplsRoute]) -> None:
+        with self._lock:
+            table = self.mpls.setdefault(client_id, {})
+            for route in routes:
+                table[route.top_label] = route
+            self._bump("fibagent.kernel.add_mpls", len(routes))
+
+    def delete_mpls_routes(self, client_id: int, labels: list[int]) -> None:
+        with self._lock:
+            table = self.mpls.setdefault(client_id, {})
+            for label in labels:
+                table.pop(label, None)
+            self._bump("fibagent.kernel.del_mpls", len(labels))
+
+    def sync_fib(self, client_id: int, routes: list[UnicastRoute]) -> None:
+        """Full-state sync: program everything advertised, withdraw every
+        kernel route of this client's protocol not in the set (reference:
+        NetlinkFibHandler::future_syncFib keep/add/remove diff)."""
+        import ipaddress
+
+        with self._lock:
+            # canonical prefix strings: the kernel readback is normalized
+            # (e.g. "2001:0DB8::/64" comes back "2001:db8::/64"), so the
+            # diff must compare canonical forms or syncs churn
+            wanted = {
+                str(ipaddress.ip_network(r.dest)): r for r in routes
+            }
+            current = {
+                r.dst
+                for r in self.nl.get_routes(
+                    protocol=self._protocol(client_id), table=self.table_id
+                )
+            }
+            for route in routes:
+                self.nl.add_route(self._to_route_info(client_id, route))
+            from ..nl.netlink import RouteInfo
+
+            for dst in current - set(wanted):
+                self.nl.del_route(
+                    RouteInfo(
+                        dst=dst,
+                        table=self.table_id,
+                        protocol=self._protocol(client_id),
+                    )
+                )
+            self._bump("fibagent.kernel.sync_fib")
+
+    def sync_mpls_fib(self, client_id: int, routes: list[MplsRoute]) -> None:
+        with self._lock:
+            self.mpls[client_id] = {r.top_label: r for r in routes}
+            self._bump("fibagent.kernel.sync_mpls_fib")
+
+    def get_route_table_by_client(self, client_id: int) -> list[UnicastRoute]:
+        with self._lock:
+            index_name = {
+                l.if_index: l.if_name for l in self.nl.get_all_links()
+            }
+            out = []
+            for r in self.nl.get_routes(
+                protocol=self._protocol(client_id), table=self.table_id
+            ):
+                out.append(
+                    UnicastRoute(
+                        dest=r.dst,
+                        next_hops=[
+                            NextHop(
+                                address=nh.gateway or "",
+                                if_name=index_name.get(nh.if_index),
+                                weight=nh.weight,
+                            )
+                            for nh in r.nexthops
+                        ],
+                    )
+                )
+            return sorted(out, key=lambda r: r.dest)
+
+    def get_mpls_route_table_by_client(self, client_id: int) -> list[MplsRoute]:
+        with self._lock:
+            return sorted(
+                self.mpls.get(client_id, {}).values(),
+                key=lambda r: r.top_label,
+            )
+
+    def alive_since(self) -> int:
+        return self._alive_since
+
+    def get_counters(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self.counters)
+
+
 class FibAgentServer:
     """NDJSON-RPC server fronting a SimulatedRouteTable — the process
     boundary the reference crosses with thrift (Fib -> platform agent)."""
 
     def __init__(
         self,
-        table: Optional[SimulatedRouteTable] = None,
+        table: Any = None,  # SimulatedRouteTable | KernelRouteTable
         host: str = "::1",
         port: int = 0,
     ) -> None:
@@ -341,13 +546,28 @@ def main(argv: Optional[Iterable[str]] = None) -> int:
     )
     parser.add_argument("--host", default="::1")
     parser.add_argument("--port", type=int, default=60100)
+    parser.add_argument(
+        "--kernel",
+        action="store_true",
+        help="program REAL kernel routes via rtnetlink (needs "
+        "CAP_NET_ADMIN); default is the simulated table",
+    )
+    parser.add_argument(
+        "--route-table",
+        type=int,
+        default=None,
+        help="kernel routing table id (default: main/254)",
+    )
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(list(argv) if argv is not None else None)
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
-    server = FibAgentServer(host=args.host, port=args.port)
+    table = (
+        KernelRouteTable(table_id=args.route_table) if args.kernel else None
+    )
+    server = FibAgentServer(table=table, host=args.host, port=args.port)
     print(f"fib-agent listening on [{args.host}]:{args.port}", flush=True)
     try:
         server.run_forever()
