@@ -148,6 +148,11 @@ class DeviceSpfBackend:
         self._kth_results: "weakref.WeakKeyDictionary[LinkState, tuple[int, dict]]" = (
             weakref.WeakKeyDictionary()
         )
+        # topology fingerprint -> learned fixed-sweep hint (see _hint_key)
+        self._hint_by_shape: dict[tuple, int] = {}
+        # jitted sharded SPF step per Mesh (re-jitting per prefetch would
+        # pay a full retrace+compile each call)
+        self._mesh_steps: dict = {}
 
     def _mirror(self, link_state: LinkState):
         from .csr import CsrTopology
@@ -155,10 +160,34 @@ class DeviceSpfBackend:
         csr = self._mirrors.get(link_state)
         if csr is None:
             csr = CsrTopology.from_link_state(link_state)
+            # the relax depth is a property of the topology SHAPE, so a
+            # fresh mirror of a same-shaped topology starts from the
+            # learned fixed-sweep hint instead of re-learning it by
+            # doubling (each failed guess costs a full device dispatch)
+            learned = self._hint_by_shape.get(self._hint_key(csr))
+            if learned is not None:
+                csr._sweep_hint = learned
             self._mirrors[link_state] = csr
         elif csr.version != link_state.version:
             csr.refresh(link_state)
         return csr
+
+    @staticmethod
+    def _hint_key(csr) -> tuple:
+        # node/edge COUNTS, not just padded capacities: capacities are
+        # power-of-two roundings, and hints only ever grow — a deep
+        # chain-like topology must not poison a shallow fabric that
+        # happens to round to the same capacity bucket
+        return (csr.n_nodes, csr.n_edges, csr.node_capacity, csr.edge_capacity)
+
+    def _harvest_hint(self, csr) -> None:
+        # max, not overwrite: two coexisting same-key topologies must not
+        # ping-pong the stored value downward (a too-small seed costs a
+        # failed dispatch; a too-large one only extra sweeps)
+        key = self._hint_key(csr)
+        self._hint_by_shape[key] = max(
+            self._hint_by_shape.get(key, 0), csr._sweep_hint
+        )
 
     def csr_mirror(self, link_state: LinkState):
         """Public access to the incrementally-maintained CSR mirror (used
@@ -185,6 +214,67 @@ class DeviceSpfBackend:
         if missing:
             csr = self._mirror(link_state)
             cache.update(csr.spf_from(missing))
+            self._harvest_hint(csr)
+
+    def prefetch_via_mesh(
+        self, link_state: LinkState, sources: list[str], mesh
+    ) -> None:
+        """Batch-prefetch over a multi-chip `jax.sharding.Mesh`: the
+        source axis is sharded over the mesh's batch dimension
+        (parallel/mesh.py spf_step_sharded), so the device side of an
+        all-node route view on an n-chip mesh costs ~1/n of the
+        single-chip call.  Results land in the same per-LinkState cache
+        the solver reads, so build_route_db after a mesh prefetch never
+        re-dispatches.
+
+        The mesh step returns distances + SP-DAG only; first-hop sets are
+        decoded host-side (to_spf_results' propagation fallback), which is
+        fine for control-plane views at fabric scale but is NOT the
+        per-tile 100k pipeline (that stays on the single-chip
+        spf_forward_full path with device-bit-packed first hops)."""
+        from ..parallel.mesh import spf_step_sharded
+
+        if link_state.num_nodes() < self.min_device_nodes:
+            return  # get_spf_result serves the host path below this size
+        cache = self._result_cache(link_state)
+        missing = [
+            s
+            for s in sources
+            if s not in cache and link_state.links_from_node(s)
+        ]
+        if not missing:
+            return
+        csr = self._mirror(link_state)
+        step = self._mesh_steps.get(mesh)
+        if step is None:
+            # jit once per mesh; re-jitting per prefetch would retrace
+            # and recompile the sharded program every call
+            step = self._mesh_steps[mesh] = spf_step_sharded(mesh)
+        batch = mesh.devices.shape[0]
+        src_ids = np.asarray(
+            [csr.node_id[s] for s in missing], dtype=np.int32
+        )
+        pad = (-len(src_ids)) % batch
+        if pad:
+            src_ids = np.concatenate(
+                [src_ids, np.zeros(pad, dtype=np.int32)]
+            )
+        dist, dag = step(
+            src_ids,
+            csr.ell,
+            csr.edge_src,
+            csr.edge_dst,
+            csr.edge_metric,
+            csr.edge_up,
+            csr.node_overloaded,
+        )
+        cache.update(
+            csr.to_spf_results(
+                missing,
+                np.asarray(dist)[: len(missing)],
+                np.asarray(dag)[: len(missing)],
+            )
+        )
 
     def get_spf_result(self, link_state: LinkState, src: str) -> SpfResult:
         if link_state.num_nodes() < self.min_device_nodes:
@@ -198,6 +288,7 @@ class DeviceSpfBackend:
             return link_state.get_spf_result(src)
         csr = self._mirror(link_state)
         cache.update(csr.spf_from([src]))
+        self._harvest_hint(csr)
         return cache[src]
 
     # -- batched k-shortest edge-disjoint paths -----------------------------

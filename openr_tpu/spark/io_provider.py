@@ -15,6 +15,7 @@ message granularity — which keeps Spark itself transport-agnostic:
 from __future__ import annotations
 
 import asyncio
+import logging
 import socket
 import struct
 import threading
@@ -22,8 +23,24 @@ import time
 from dataclasses import dataclass
 from typing import Optional, Protocol
 
+log = logging.getLogger(__name__)
+
 MCAST_GROUP = "ff02::1"
 DEFAULT_UDP_PORT = 6666  # reference: Constants::kUdpPort
+
+# SOL_SOCKET option/cmsg id for nanosecond kernel receive timestamps
+# (linux: SO_TIMESTAMPNS_OLD; cmsg SCM_TIMESTAMPNS carries a timespec);
+# prefer the stdlib constant where exposed — 35 is the mainstream-Linux
+# value only
+SO_TIMESTAMPNS = getattr(socket, "SO_TIMESTAMPNS", 35)
+_TIMESPEC = struct.Struct("@qq")  # tv_sec, tv_nsec
+
+
+def _realtime_us() -> int:
+    """The RxPacket timestamp domain is CLOCK_REALTIME microseconds —
+    the clock kernel SO_TIMESTAMPNS stamps arrive on (Spark's send
+    stamps use the same clock; see spark.send_hello)."""
+    return int(time.clock_gettime(time.CLOCK_REALTIME) * 1e6)
 
 
 @dataclass(slots=True)
@@ -149,6 +166,11 @@ class _MockEndpoint:
         loop = self._loop
         if loop is None or self._closed or loop.is_closed():
             return
+        # stamp NOW (+ simulated wire latency), not when the receiver's
+        # event loop gets around to the callback — the fabric models the
+        # KERNEL timestamping point (SO_TIMESTAMPNS), so receiver-side
+        # scheduler load must not inflate RTTs
+        arrival_ts_us = _realtime_us() + int(latency_s * 1e6)
 
         def _put() -> None:
             if self._closed or if_name not in self._interfaces:
@@ -158,7 +180,7 @@ class _MockEndpoint:
                     if_name=if_name,
                     data=data,
                     src_addr=src_addr,
-                    recv_ts_us=int(time.monotonic() * 1e6),
+                    recv_ts_us=arrival_ts_us,
                 )
             )
 
@@ -189,6 +211,7 @@ class UdpIoProvider:
 
     def __init__(self, port: int = DEFAULT_UDP_PORT) -> None:
         self.port = port
+        self.send_failures = 0
         self._sock: Optional[socket.socket] = None
         self._if_index: dict[str, int] = {}  # name -> index
         self._if_name: dict[int, str] = {}  # index -> name
@@ -203,6 +226,13 @@ class UdpIoProvider:
         sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         sock.setsockopt(socket.IPPROTO_IPV6, socket.IPV6_RECVPKTINFO, 1)
         sock.setsockopt(socket.IPPROTO_IPV6, socket.IPV6_MULTICAST_LOOP, 0)
+        # kernel rx timestamps: RTTs measured from the moment the packet
+        # hit the host, not when the event loop drained it (reference:
+        # Spark.cpp:447-448 SO_TIMESTAMPNS + recvmsg cmsg)
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, SO_TIMESTAMPNS, 1)
+        except OSError:
+            pass  # stamped in userspace below
         sock.bind(("::", self.port))
         sock.setblocking(False)
         self._sock = sock
@@ -213,11 +243,13 @@ class UdpIoProvider:
         while True:
             try:
                 data, ancdata, _flags, addr = self._sock.recvmsg(
-                    65535, socket.CMSG_SPACE(20)
+                    65535,
+                    socket.CMSG_SPACE(20) + socket.CMSG_SPACE(_TIMESPEC.size),
                 )
             except BlockingIOError:
                 return
             if_index = 0
+            recv_ts_us = 0
             for level, ctype, cdata in ancdata:
                 if (
                     level == socket.IPPROTO_IPV6
@@ -225,6 +257,13 @@ class UdpIoProvider:
                     and len(cdata) >= 20
                 ):
                     if_index = struct.unpack_from("@I", cdata, 16)[0]
+                elif (
+                    level == socket.SOL_SOCKET
+                    and ctype == SO_TIMESTAMPNS  # SCM_TIMESTAMPNS
+                    and len(cdata) >= _TIMESPEC.size
+                ):
+                    sec, nsec = _TIMESPEC.unpack_from(cdata, 0)
+                    recv_ts_us = sec * 1_000_000 + nsec // 1_000
             if_name = self._if_name.get(if_index)
             if if_name is None:
                 continue  # not a tracked interface
@@ -233,7 +272,7 @@ class UdpIoProvider:
                     if_name=if_name,
                     data=data,
                     src_addr=addr[0],
-                    recv_ts_us=int(time.monotonic() * 1e6),
+                    recv_ts_us=recv_ts_us or _realtime_us(),
                 )
             )
 
@@ -267,7 +306,23 @@ class UdpIoProvider:
         if_index = self._if_index.get(if_name)
         if if_index is None or self._sock is None:
             return
-        self._sock.sendto(data, (MCAST_GROUP, self.port, 0, if_index))
+        try:
+            self._sock.sendto(data, (MCAST_GROUP, self.port, 0, if_index))
+        except OSError as exc:
+            # transient interface conditions (IPv6 DAD still running,
+            # link-down race) make multicast sends fail with
+            # EADDRNOTAVAIL/ENETDOWN; a raised send would unwind Spark's
+            # timer callback and permanently stop the hello chain.  The
+            # reference IoProvider surfaces errno and Spark logs+continues
+            # (the next periodic hello retries) — match that.
+            self.send_failures += 1
+            if self.send_failures % 16 == 1:  # rate-limited: DAD spams
+                log.warning(
+                    "spark udp send on %s failing (%d so far): %s",
+                    if_name,
+                    self.send_failures,
+                    exc,
+                )
 
     async def recv(self) -> RxPacket:
         return await self._queue.get()

@@ -330,7 +330,13 @@ class Spark(OpenrEventBase):
         self, if_name: str, restarting: bool = False, solicit: bool = False
     ) -> None:
         self._seq_num += 1
-        now_us = int(time.monotonic() * 1e6)
+        # CLOCK_REALTIME: the RTT timestamp domain must match the
+        # transport's KERNEL rx timestamps (UdpIoProvider SO_TIMESTAMPNS,
+        # reference: Spark.cpp:447-448) — t4 (kernel rx) and t1 (this
+        # send stamp) difference only makes sense on one clock.  NTP
+        # steps between t1 and t4 produce outliers; StepDetector exists
+        # to filter exactly those.
+        now_us = int(time.clock_gettime(time.CLOCK_REALTIME) * 1e6)
         neighbor_infos = {}
         for name, neighbor in self.neighbors.get(if_name, {}).items():
             neighbor_infos[name] = ReflectedNeighborInfo(

@@ -146,3 +146,84 @@ class TestFib:
         assert names[0] == "DECISION_RECEIVED"
         assert "OPENR_FIB_ROUTES_PROGRAMMED" in names
         assert fib.get_perf_db()
+
+
+class TestWedgedAgent:
+    def test_wedged_agent_trips_keepalive_and_recovery_resyncs(self):
+        """An agent that ACCEPTS connections but never replies (wedged,
+        not crashed) must trip Fib's keepalive/backoff machinery — and a
+        healthy agent appearing on the same port must receive a full
+        resync (reference: keepAliveCheck + syncRouteDbDebounced,
+        openr/fib/Fib.h:161-181; FibTest agent-restart coverage)."""
+        import socket as _socket
+        import threading
+
+        from openr_tpu.platform import FibAgentServer, TcpFibAgent
+        from tests.test_platform_agent import free_port
+
+        port = free_port()
+
+        # wedge server: accept + read, never write
+        wedge = _socket.socket(_socket.AF_INET6, _socket.SOCK_STREAM)
+        wedge.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+        wedge.bind(("::1", port))
+        wedge.listen(8)
+        wedged_conns = []
+        stop_wedge = threading.Event()
+
+        def wedge_loop():
+            wedge.settimeout(0.2)
+            while not stop_wedge.is_set():
+                try:
+                    conn, _ = wedge.accept()
+                    wedged_conns.append(conn)  # hold open, never reply
+                except OSError:
+                    continue
+
+        wedge_thread = threading.Thread(target=wedge_loop, daemon=True)
+        wedge_thread.start()
+
+        routeq: ReplicateQueue = ReplicateQueue()
+        agent_client = TcpFibAgent(port=port, timeout_s=0.3)
+        fib = Fib(
+            "node1",
+            routeq.get_reader(),
+            agent_client,
+            keepalive_interval_s=0.1,
+            sync_initial_backoff_s=0.02,
+            sync_max_backoff_s=0.2,
+        )
+        fib.run()
+        try:
+            routeq.push(update(route("::9:0/112")))
+            # wedged agent: keepalive calls time out and are COUNTED, the
+            # route state never reaches synced
+            assert wait_for(
+                lambda: fib.counters.get("fib.thrift.failure.keepalive", 0)
+                >= 2,
+                timeout=10,
+            ), fib.counters
+            assert not fib.route_state.synced
+
+            # the supervisor replaces the wedged agent with a healthy one
+            stop_wedge.set()
+            wedge_thread.join(3)
+            for c in wedged_conns:
+                c.close()
+            wedge.close()
+            server = FibAgentServer(host="::1", port=port)
+            server.start()
+            try:
+                # backoff'd retries must reconnect and full-sync the routes
+                assert wait_for(
+                    lambda: "::9:0/112"
+                    in server.table.unicast.get(CLIENT, {}),
+                    timeout=15,
+                ), server.table.unicast
+                assert wait_for(lambda: fib.route_state.synced, timeout=5)
+            finally:
+                server.stop()
+        finally:
+            routeq.close()
+            fib.stop()
+            fib.wait_until_stopped(5)

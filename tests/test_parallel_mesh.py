@@ -196,3 +196,124 @@ class TestMeshWhatIf:
         )
         np.testing.assert_array_equal(np.asarray(dist), np.asarray(ref_dist))
         np.testing.assert_array_equal(np.asarray(dag), np.asarray(ref_dag))
+
+
+def _fat_tree_link_state(
+    pods: int = 8, planes: int = 4, ssw_per_plane: int = 6, rsw_per_pod: int = 64
+) -> LinkState:
+    """Fat-tree fabric as a LinkState (reference: createFabric,
+    RoutingBenchmarkUtils.h:320) — the realistically-shaped topology the
+    mesh tests shard."""
+    from openr_tpu.types import Adjacency, AdjacencyDatabase
+
+    adjs: dict[str, list] = {}
+
+    def connect(a: str, b: str):
+        adjs.setdefault(a, []).append(
+            Adjacency(
+                other_node_name=b,
+                if_name=f"{a}:{b}",
+                other_if_name=f"{b}:{a}",
+                metric=1,
+                next_hop_v6=f"fe80::{b}",
+            )
+        )
+        adjs.setdefault(b, []).append(
+            Adjacency(
+                other_node_name=a,
+                if_name=f"{b}:{a}",
+                other_if_name=f"{a}:{b}",
+                metric=1,
+                next_hop_v6=f"fe80::{a}",
+            )
+        )
+
+    for pod in range(pods):
+        for f in range(planes):
+            fsw = f"fsw-{pod}-{f}"
+            for s in range(ssw_per_plane):
+                connect(fsw, f"ssw-{f}-{s}")
+            for r in range(rsw_per_pod):
+                connect(fsw, f"rsw-{pod}-{r}")
+    ls = LinkState()
+    for node, a in adjs.items():
+        ls.update_adjacency_database(
+            AdjacencyDatabase(this_node_name=node, adjacencies=a)
+        )
+    return ls
+
+
+class TestMeshThroughSolver:
+    def test_fat_tree_mesh_prefetch_route_equality(self, eight_cpu_devices):
+        """VERDICT r2 #8: a realistically-sized fabric sharded over the
+        8-device mesh, driven through DeviceSpfBackend ->
+        SpfSolver.build_route_db, must produce route-level equality with
+        the host-Dijkstra backend — ECMP sets, MPLS labels and all."""
+        from openr_tpu.decision.prefix_state import PrefixState
+        from openr_tpu.decision.spf_solver import DeviceSpfBackend, SpfSolver
+        from openr_tpu.types import PrefixEntry
+
+        ls = _fat_tree_link_state()
+        nodes = ls.node_names
+        assert len(nodes) > 500  # realistic fabric, not a toy
+        ps = PrefixState()
+        for i in range(0, len(nodes), 16):
+            ps.update_prefix(
+                nodes[i], "0", PrefixEntry(prefix=f"fc00:{i:x}::/64")
+            )
+
+        mesh = make_mesh(eight_cpu_devices)
+        backend = DeviceSpfBackend(min_device_nodes=64)
+        # prefetch EVERY node's SPF through the sharded mesh step
+        backend.prefetch_via_mesh(ls, nodes, mesh)
+
+        for my_node in ("rsw-0-0", "fsw-3-2", "ssw-1-4"):
+            dev_solver = SpfSolver(my_node, spf_backend=backend)
+            host_solver = SpfSolver(my_node)
+            rdb_dev = dev_solver.build_route_db({"0": ls}, ps)
+            rdb_host = host_solver.build_route_db({"0": ls}, ps)
+            assert rdb_dev.unicast_routes == rdb_host.unicast_routes
+            assert rdb_dev.mpls_routes == rdb_host.mpls_routes
+
+    def test_whatif_fleet_1k_variants(self, eight_cpu_devices):
+        """A 1k-variant failure fleet sharded over the mesh matches the
+        single-device masked kernel row-for-row."""
+        import numpy as np
+
+        from openr_tpu.ops.sssp import spf_forward_ell_masked
+        from openr_tpu.parallel.mesh import whatif_step_sharded
+
+        csr = _grid_csr(8)  # 64 nodes
+        n_variants = 1024
+        rng = np.random.default_rng(7)
+        fail = rng.integers(0, csr.n_edges, size=n_variants)
+        mask = np.ones((n_variants, csr.edge_capacity), dtype=bool)
+        mask[np.arange(n_variants), fail] = False
+        sources = rng.integers(
+            0, csr.n_nodes, size=n_variants
+        ).astype(np.int32)
+
+        mesh = make_mesh(eight_cpu_devices)
+        step = whatif_step_sharded(mesh)
+        dist_m, dag_m = step(
+            sources,
+            csr.ell,
+            csr.edge_src,
+            csr.edge_dst,
+            csr.edge_metric,
+            csr.edge_up,
+            csr.node_overloaded,
+            np.ascontiguousarray(mask.T),  # step takes edge-major [E, S]
+        )
+        dist_1, dag_1 = spf_forward_ell_masked(
+            sources,
+            csr.ell,
+            csr.edge_src,
+            csr.edge_dst,
+            csr.edge_metric,
+            csr.edge_up,
+            csr.node_overloaded,
+            mask,
+        )
+        np.testing.assert_array_equal(np.asarray(dist_m), np.asarray(dist_1))
+        np.testing.assert_array_equal(np.asarray(dag_m), np.asarray(dag_1))
