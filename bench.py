@@ -504,12 +504,15 @@ def bench_tilfa(topo, source: int, reps: int) -> dict:
     }
 
 
-def bench_decision_cold_start(n_side: int = 10, reps: int = 3) -> dict:
+def bench_decision_cold_start(
+    n_side: int = 10, reps: int = 3, dbs=None, name: Optional[str] = None
+) -> dict:
     """Decision-module cold start: initial adj+prefix publications pushed
     into a LIVE Decision event base -> debounce -> full route build ->
     DecisionRouteUpdate emitted (reference: BM_DecisionGridInitialUpdate,
     DecisionBenchmark.cpp:19-33, which measures the accumulated
-    DECISION_DEBOUNCE -> ROUTE_UPDATE perf-event span)."""
+    DECISION_DEBOUNCE -> ROUTE_UPDATE perf-event span).  With `dbs`,
+    benchmarks an arbitrary topology (fabric rows, BM_DecisionFabric)."""
     from openr_tpu.decision.decision import Decision
     from openr_tpu.runtime.queue import ReplicateQueue
     from openr_tpu.serializer import dumps
@@ -523,18 +526,18 @@ def bench_decision_cold_start(n_side: int = 10, reps: int = 3) -> dict:
     )
     from openr_tpu.utils.topo import grid_topology
 
-    dbs = grid_topology(n_side)
-    n_nodes = n_side * n_side
+    if dbs is None:
+        dbs = grid_topology(n_side)
+        name = name or f"grid{n_side * n_side}"
+    n_nodes = len(dbs)
     kv = {}
-    for db in dbs:
+    for i, db in enumerate(dbs):
         kv[adj_key(db.this_node_name)] = Value(
             version=1, originator_id=db.this_node_name, value=dumps(db)
         )
         pdb = PrefixDatabase(
             this_node_name=db.this_node_name,
-            prefix_entries=[
-                PrefixEntry(prefix=f"fc00:{db.this_node_name[5:].replace('-', ':')}::/96")
-            ],
+            prefix_entries=[PrefixEntry(prefix=f"fc00:{i:x}::/96")],
         )
         kv[
             prefix_key(
@@ -572,7 +575,7 @@ def bench_decision_cold_start(n_side: int = 10, reps: int = 3) -> dict:
             decision.stop()
             decision.wait_until_stopped(5)
     return {
-        "topology": f"grid{n_nodes}",
+        "topology": name or f"grid{n_nodes}",
         "n_nodes": n_nodes,
         "cold_start_ms_min": round(min(times), 3),
         "cold_start_ms_all": [round(t, 2) for t in times],
@@ -963,9 +966,35 @@ def main() -> None:
 
     # --- host-only rows first: they need no device and must survive an
     # --- accelerator outage (pure-Python solver paths + host subsystems)
+    def _fabric_cold(pods: int, label: str):
+        from openr_tpu.utils.topo import fabric_topology
+
+        dbs = fabric_topology(pods, rsw_per_pod=28)
+        return bench_decision_cold_start(reps=2, dbs=dbs, name=label)
+
     for name, fn in (
         ("incremental_prefix_grid100", bench_incremental_prefix_updates),
         ("decision_cold_start_grid100", bench_decision_cold_start),
+        # reference scale points (BM_DecisionGridInitialUpdate 1k grid,
+        # BM_DecisionFabric 344/1000 switches, DecisionBenchmark.cpp:19-86)
+        (
+            "decision_cold_start_grid1024",
+            lambda: bench_decision_cold_start(n_side=32, reps=2),
+        ),
+        (
+            "decision_cold_start_fabric336",
+            lambda: _fabric_cold(10, "fabric336"),
+        ),
+        (
+            "decision_cold_start_fabric1008",
+            lambda: _fabric_cold(31, "fabric1008"),
+        ),
+        # the reference BM's largest grid; single rep (~3s measured after
+        # the publication-parse fix — it was ~2.9s for 1k BEFORE it)
+        (
+            "decision_cold_start_grid10000",
+            lambda: bench_decision_cold_start(n_side=100, reps=1),
+        ),
     ):
         try:
             details["rows"][name] = fn()

@@ -425,6 +425,142 @@ def cmd_config(client: CtrlClient, args) -> None:
     _print_json(client.call("getRunningConfig"))
 
 
+def cmd_config_dryrun(client: CtrlClient, args) -> None:
+    """Validate a config file through the daemon WITHOUT applying it
+    (reference: dryrunConfig RPC, OpenrCtrlHandler.h:69-78)."""
+    try:
+        with open(args.file) as f:
+            contents = f.read()
+    except OSError as exc:
+        # distinguish a bad file path from main()'s "cannot reach ctrl
+        # server" OSError handler
+        print(f"cannot read {args.file}: {exc}")
+        raise SystemExit(2)
+    try:
+        parsed = client.call("dryrunConfig", file_contents=contents)
+    except RuntimeError as exc:
+        print(f"INVALID: {exc}")
+        raise SystemExit(1)
+    print("VALID")
+    if args.verbose:
+        _print_json(parsed)
+
+
+def cmd_kvstore_compare(client: CtrlClient, args) -> None:
+    """Diff this node's store against another node's (reference:
+    breeze kvstore compare, openr/py/openr/cli/commands/kvstore.py)."""
+    other = CtrlClient(args.other_host, args.other_port, tls=client.tls)
+    try:
+        # hash_only: the compare is on (version, originator, hash) —
+        # fetching every value blob from both nodes would be waste
+        mine = client.call(
+            "getKvStoreKeyValsFilteredArea",
+            area=args.area,
+            match_all=True,
+            hash_only=True,
+        ).key_vals
+        try:
+            theirs = other.call(
+                "getKvStoreKeyValsFilteredArea",
+                area=args.area,
+                match_all=True,
+                hash_only=True,
+            ).key_vals
+        except OSError as exc:
+            print(
+                f"cannot reach remote ctrl server at "
+                f"[{args.other_host}]:{args.other_port}: {exc}"
+            )
+            raise SystemExit(2)
+    finally:
+        other.close()
+    rows = []
+    for key in sorted(set(mine) | set(theirs)):
+        a, b = mine.get(key), theirs.get(key)
+        if a is None:
+            rows.append([key, "MISSING-LOCAL", "", f"v{b.version}@{b.originator_id}"])
+        elif b is None:
+            rows.append([key, "MISSING-REMOTE", f"v{a.version}@{a.originator_id}", ""])
+        elif (a.version, a.originator_id, a.hash) != (
+            b.version,
+            b.originator_id,
+            b.hash,
+        ):
+            rows.append(
+                [
+                    key,
+                    "DIFFERS",
+                    f"v{a.version}@{a.originator_id}",
+                    f"v{b.version}@{b.originator_id}",
+                ]
+            )
+    if not rows:
+        print(f"stores agree on {len(mine)} keys")
+        return
+    _table(rows, ["Key", "Status", "Local", "Remote"])
+    raise SystemExit(1)
+
+
+def cmd_fib_mpls(client: CtrlClient, args) -> None:
+    routes = client.call(
+        "getMplsRoutesFiltered", labels=args.labels or None
+    )
+    rows = [
+        [
+            r.top_label,
+            ", ".join(
+                f"{nh.address}@{nh.if_name or '-'}"
+                + (
+                    f" {nh.mpls_action.action.name}"
+                    if nh.mpls_action is not None
+                    else ""
+                )
+                for nh in r.next_hops
+            ),
+        ]
+        for r in routes
+    ]
+    _table(rows, ["Label", "NextHops"])
+
+
+def cmd_prefixmgr_withdraw_by_type(client: CtrlClient, args) -> None:
+    client.call("withdrawPrefixesByType", type=PrefixType[args.type])
+    print(f"withdrew all {args.type} prefixes")
+
+
+def cmd_tech_support(client: CtrlClient, args) -> None:
+    """One-shot operational snapshot (reference: breeze tech-support):
+    every section is best-effort so a wedged module doesn't hide the
+    others."""
+    sections = [
+        ("VERSION", lambda: client.call("getOpenrVersion")),
+        ("NODE", lambda: client.call("getMyNodeName")),
+        ("RUNNING CONFIG", lambda: client.call("getRunningConfig")),
+        ("INTERFACES", lambda: client.call("getInterfaces")),
+        ("SPARK NEIGHBORS", lambda: client.call("getSparkNeighbors")),
+        (
+            "KVSTORE SUMMARY",
+            lambda: client.call("getKvStoreAreaSummary"),
+        ),
+        ("KVSTORE PEERS", lambda: client.call("getKvStorePeersArea")),
+        (
+            "ADJACENCIES",
+            lambda: client.call("getDecisionAdjacenciesFiltered"),
+        ),
+        ("PREFIXES", lambda: client.call("getPrefixes")),
+        ("DECISION ROUTES", lambda: client.call("getRouteDb", node="")),
+        ("FIB ROUTES", lambda: client.call("getRouteDbFib")),
+        ("FIB PERF", lambda: client.call("getPerfDb")),
+        ("COUNTERS", lambda: client.call("getCounters")),
+    ]
+    for title, fetch in sections:
+        print(f"\n======== {title} ========")
+        try:
+            _print_json(fetch())
+        except Exception as exc:  # a dead module must not hide the rest
+            print(f"<unavailable: {exc}>")
+
+
 def cmd_version(client: CtrlClient, args) -> None:
     _print_json(client.call("getOpenrVersion"))
 
@@ -459,6 +595,11 @@ def build_parser() -> argparse.ArgumentParser:
     p = kv.add_parser("floodtopo")
     p.add_argument("--area", default="0")
     p.set_defaults(fn=cmd_kvstore_floodtopo)
+    p = kv.add_parser("compare")
+    p.add_argument("other_host")
+    p.add_argument("--other-port", type=int, default=2018)
+    p.add_argument("--area", default="0")
+    p.set_defaults(fn=cmd_kvstore_compare)
     p = kv.add_parser("snoop")
     p.add_argument("--area", default="0")
     p.add_argument("--prefixes", nargs="*")
@@ -497,6 +638,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(fn=cmd_fib_validate)
     p = fib.add_parser("routes")
     p.set_defaults(fn=cmd_fib_routes)
+    p = fib.add_parser("mpls")
+    p.add_argument("--labels", nargs="*", type=int, default=None)
+    p.set_defaults(fn=cmd_fib_mpls)
     p = fib.add_parser("perf")
     p.set_defaults(fn=cmd_fib_perf)
 
@@ -532,6 +676,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("prefixes", nargs="+")
     p.add_argument("--type", default="BREEZE")
     p.set_defaults(fn=cmd_prefixmgr_withdraw)
+    p = pm.add_parser("withdraw-by-type")
+    p.add_argument("--type", required=True)
+    p.set_defaults(fn=cmd_prefixmgr_withdraw_by_type)
     p = pm.add_parser("originated")
     p.set_defaults(fn=cmd_prefixmgr_originated)
 
@@ -544,8 +691,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--regex", default="")
     p.set_defaults(fn=cmd_monitor_counters)
 
-    p = sub.add_parser("config")
+    cfg = sub.add_parser("config").add_subparsers(dest="cmd")
+    p = cfg.add_parser("show")
     p.set_defaults(fn=cmd_config)
+    p = cfg.add_parser("dryrun")
+    p.add_argument("file")
+    p.add_argument("-v", "--verbose", action="store_true")
+    p.set_defaults(fn=cmd_config_dryrun)
+    # bare `breeze config` keeps showing the running config
+    sub.choices["config"].set_defaults(fn=cmd_config, cmd=None)
+    p = sub.add_parser("tech-support")
+    p.set_defaults(fn=cmd_tech_support)
     p = sub.add_parser("version")
     p.set_defaults(fn=cmd_version)
     return parser

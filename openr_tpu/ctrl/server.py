@@ -83,6 +83,9 @@ class OpenrCtrlHandler:
         m["getRunningConfig"] = lambda p: (
             self.config.to_dict() if self.config is not None else {}
         )
+        # parse+validate config file CONTENTS without applying anything
+        # (reference: dryrunConfig, OpenrCtrlHandler.h:69-78)
+        m["dryrunConfig"] = self._dryrun_config
         m["getCounters"] = lambda p: self._all_counters()
         m["getRegexCounters"] = lambda p: {
             k: v
@@ -158,6 +161,9 @@ class OpenrCtrlHandler:
         m["getUnicastRoutesFiltered"] = lambda p: self._need(
             self.fib, "fib"
         ).get_unicast_routes(p.get("prefixes"))
+        # MPLS route dumps (reference: getMplsRoutes/getMplsRoutesFiltered)
+        m["getMplsRoutes"] = lambda p: self._need(self.fib, "fib").get_route_db()[1]
+        m["getMplsRoutesFiltered"] = self._mpls_routes_filtered
         m["getPerfDb"] = lambda p: self._need(self.fib, "fib").get_perf_db()
 
         # -- link-monitor -----------------------------------------------------
@@ -198,6 +204,9 @@ class OpenrCtrlHandler:
         )
         m["syncPrefixesByType"] = lambda p: pm().sync_prefixes_by_type(
             p["type"], p["prefixes"]
+        )
+        m["withdrawPrefixesByType"] = lambda p: pm().withdraw_prefixes_by_type(
+            p["type"]
         )
         m["getPrefixes"] = lambda p: pm().get_prefixes()
         m["getPrefixesByType"] = lambda p: pm().get_prefixes(p["type"])
@@ -252,6 +261,25 @@ class OpenrCtrlHandler:
                 key_val_hashes=p.get("key_val_hashes"),
             ),
         )
+
+    def _dryrun_config(self, p: dict) -> dict:
+        """Validate config-file CONTENTS; returns the parsed config dict
+        or raises (surfaced to the client as the RPC error) — nothing is
+        applied (reference: dryrunConfig)."""
+        import json as _json
+
+        from ..config import config_from_dict
+
+        data = _json.loads(p["file_contents"])
+        return config_from_dict(data).to_dict()
+
+    def _mpls_routes_filtered(self, p: dict) -> list:
+        routes = self._need(self.fib, "fib").get_route_db()[1]
+        labels = p.get("labels")
+        if not labels:
+            return routes
+        wanted = set(labels)
+        return [r for r in routes if r.top_label in wanted]
 
     def _kvstore_set(self, p: dict) -> None:
         kvstore = self._need(self.kvstore, "kvstore")

@@ -135,3 +135,27 @@ def ring_topology(n_nodes: int, area: str = "0") -> list[AdjacencyDatabase]:
         if n_nodes > 1 and (i + 1 < n_nodes or n_nodes > 2):
             _bidir(edges, names[i], names[(i + 1) % n_nodes])
     return _to_dbs(edges, area)
+
+
+def fabric_topology(
+    pods: int,
+    planes: int = 4,
+    ssw_per_plane: int = 4,
+    rsw_per_pod: int = 4,
+    area: str = "0",
+) -> list[AdjacencyDatabase]:
+    """Three-tier fat-tree fabric (reference: createFabric,
+    RoutingBenchmarkUtils.h:320): per pod, `planes` fabric switches; fsw f
+    uplinks to every spine of plane f and downlinks to every rack switch
+    of its pod.  The reference's 344/1000/5000-switch benchmark fabrics
+    come from scaling pods/rsw_per_pod."""
+    edges: dict[str, list[Adjacency]] = {}
+    for pod in range(pods):
+        for f in range(planes):
+            fsw = f"fsw-{pod}-{f}"
+            edges.setdefault(fsw, [])
+            for s in range(ssw_per_plane):
+                _bidir(edges, fsw, f"ssw-{f}-{s}")
+            for r in range(rsw_per_pod):
+                _bidir(edges, fsw, f"rsw-{pod}-{r}")
+    return _to_dbs(edges, area)

@@ -181,3 +181,95 @@ class TestCtrlStreaming:
         with pytest.raises(RuntimeError, match="unknown method"):
             client.call("noSuchMethod")
         client.close()
+
+
+class TestCtrlGapRpcs:
+    """Round-3 ctrl/CLI surface additions (reference: dryrunConfig
+    OpenrCtrlHandler.h:69-78, getMplsRoutesFiltered,
+    withdrawPrefixesByType, breeze kvstore compare / tech-support)."""
+
+    def test_dryrun_config_valid_and_invalid(self, daemon):
+        import json as _json
+
+        client = CtrlClient(port=daemon.ctrl_port)
+        try:
+            good = _json.dumps(make_config("dryrun-check").to_dict())
+            parsed = client.call("dryrunConfig", file_contents=good)
+            assert parsed["node_name"] == "dryrun-check"
+            # nothing applied: the daemon keeps its own identity
+            assert client.call("getMyNodeName") == "solo"
+            with pytest.raises(RuntimeError):
+                client.call("dryrunConfig", file_contents="{not json")
+            bad = _json.dumps({"node_name": ""})
+            with pytest.raises(RuntimeError):
+                client.call("dryrunConfig", file_contents=bad)
+        finally:
+            client.close()
+
+    def test_mpls_routes_filtered(self, daemon):
+        client = CtrlClient(port=daemon.ctrl_port)
+        try:
+            routes = client.call("getMplsRoutesFiltered", labels=None)
+            assert isinstance(routes, list)
+            # label filter returns the subset
+            if routes:
+                lbl = routes[0].top_label
+                only = client.call("getMplsRoutesFiltered", labels=[lbl])
+                assert [r.top_label for r in only] == [lbl]
+            assert client.call("getMplsRoutesFiltered", labels=[1 << 19]) == []
+        finally:
+            client.close()
+
+    def test_withdraw_prefixes_by_type(self, daemon):
+        from openr_tpu.types import PrefixEntry, PrefixType
+
+        client = CtrlClient(port=daemon.ctrl_port)
+        try:
+            client.call(
+                "advertisePrefixes",
+                type=PrefixType.BREEZE,
+                prefixes=[PrefixEntry(prefix="fc51::/64", type=PrefixType.BREEZE)],
+            )
+            assert client.call("getPrefixesByType", type=PrefixType.BREEZE)
+            client.call("withdrawPrefixesByType", type=PrefixType.BREEZE)
+            assert not client.call(
+                "getPrefixesByType", type=PrefixType.BREEZE
+            )
+        finally:
+            client.close()
+
+    def test_breeze_tech_support_and_compare(self, daemon, capsys):
+        from openr_tpu.cli import breeze
+
+        rc = breeze.main(["-p", str(daemon.ctrl_port), "tech-support"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        for section in ("VERSION", "RUNNING CONFIG", "COUNTERS", "FIB ROUTES"):
+            assert f"======== {section} ========" in out
+        # compare against ITSELF: stores agree
+        rc = breeze.main(
+            ["-p", str(daemon.ctrl_port), "kvstore", "compare", "::1",
+             "--other-port", str(daemon.ctrl_port)]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "agree" in out
+
+    def test_breeze_config_dryrun(self, daemon, tmp_path, capsys):
+        import json as _json
+
+        from openr_tpu.cli import breeze
+
+        good = tmp_path / "good.conf"
+        good.write_text(_json.dumps(make_config("x").to_dict()))
+        rc = breeze.main(
+            ["-p", str(daemon.ctrl_port), "config", "dryrun", str(good)]
+        )
+        assert rc == 0
+        assert "VALID" in capsys.readouterr().out
+        bad = tmp_path / "bad.conf"
+        bad.write_text("{}")
+        with pytest.raises(SystemExit):
+            breeze.main(
+                ["-p", str(daemon.ctrl_port), "config", "dryrun", str(bad)]
+            )

@@ -201,45 +201,16 @@ class TestMeshWhatIf:
 def _fat_tree_link_state(
     pods: int = 8, planes: int = 4, ssw_per_plane: int = 6, rsw_per_pod: int = 64
 ) -> LinkState:
-    """Fat-tree fabric as a LinkState (reference: createFabric,
-    RoutingBenchmarkUtils.h:320) — the realistically-shaped topology the
-    mesh tests shard."""
-    from openr_tpu.types import Adjacency, AdjacencyDatabase
+    """Fat-tree fabric as a LinkState — built from the product generator
+    (openr_tpu.utils.topo.fabric_topology) so the test validates the same
+    wiring the bench rows use."""
+    from openr_tpu.utils.topo import fabric_topology
 
-    adjs: dict[str, list] = {}
-
-    def connect(a: str, b: str):
-        adjs.setdefault(a, []).append(
-            Adjacency(
-                other_node_name=b,
-                if_name=f"{a}:{b}",
-                other_if_name=f"{b}:{a}",
-                metric=1,
-                next_hop_v6=f"fe80::{b}",
-            )
-        )
-        adjs.setdefault(b, []).append(
-            Adjacency(
-                other_node_name=a,
-                if_name=f"{b}:{a}",
-                other_if_name=f"{a}:{b}",
-                metric=1,
-                next_hop_v6=f"fe80::{a}",
-            )
-        )
-
-    for pod in range(pods):
-        for f in range(planes):
-            fsw = f"fsw-{pod}-{f}"
-            for s in range(ssw_per_plane):
-                connect(fsw, f"ssw-{f}-{s}")
-            for r in range(rsw_per_pod):
-                connect(fsw, f"rsw-{pod}-{r}")
     ls = LinkState()
-    for node, a in adjs.items():
-        ls.update_adjacency_database(
-            AdjacencyDatabase(this_node_name=node, adjacencies=a)
-        )
+    for db in fabric_topology(
+        pods, planes=planes, ssw_per_plane=ssw_per_plane, rsw_per_pod=rsw_per_pod
+    ):
+        ls.update_adjacency_database(db)
     return ls
 
 
