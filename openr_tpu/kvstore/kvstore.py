@@ -82,15 +82,18 @@ DUAL_SEND_BACKLOG_MAX = 64
 
 def generate_hash(version: int, originator_id: str, value: Optional[bytes]) -> int:
     """Deterministic 63-bit hash of (version, originatorId, value)
-    (reference: generateHash, openr/common/Util.cpp)."""
-    h = hashlib.blake2b(digest_size=8)
-    h.update(str(version).encode())
-    h.update(b"\x00")
-    h.update(originator_id.encode())
-    h.update(b"\x00")
+    (reference: generateHash, openr/common/Util.cpp).
+
+    Single-shot construction (identical byte layout to the incremental
+    form: version NUL originator NUL value): per-hash Python call count
+    was ~70% of merge_key_values' cost at 10k-key publications."""
+    data = b"%d\x00%s\x00" % (version, originator_id.encode())
     if value is not None:
-        h.update(value)
-    return int.from_bytes(h.digest(), "big") >> 1
+        data += value
+    return (
+        int.from_bytes(hashlib.blake2b(data, digest_size=8).digest(), "big")
+        >> 1
+    )
 
 
 def compare_values(v1: Value, v2: Value) -> int:

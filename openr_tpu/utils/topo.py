@@ -69,12 +69,18 @@ def fat_tree_topology(
     n_planes: int = 2,
     n_fsw_per_pod: int = 2,
     n_rsw_per_pod: int = 4,
+    n_ssw_per_plane: int | None = None,
     area: str = "0",
 ) -> list[AdjacencyDatabase]:
     """Three-tier fabric: spine (ssw) planes — fabric (fsw) — rack (rsw)
-    (reference: createFabric, RoutingBenchmarkUtils.h:320)."""
+    (reference: createFabric, RoutingBenchmarkUtils.h:320).  fsw f of a
+    pod uplinks to every spine of plane f % n_planes; with the default
+    n_ssw_per_plane (== n_fsw_per_pod) this matches the reference's
+    square wiring, and an explicit value gives the benchmark fabrics'
+    rectangular spine planes."""
     edges: dict[str, list[Adjacency]] = {}
-    n_ssw_per_plane = n_fsw_per_pod
+    if n_ssw_per_plane is None:
+        n_ssw_per_plane = n_fsw_per_pod
     for plane in range(n_planes):
         for s in range(n_ssw_per_plane):
             edges.setdefault(f"ssw-{plane}-{s}", [])
@@ -144,18 +150,14 @@ def fabric_topology(
     rsw_per_pod: int = 4,
     area: str = "0",
 ) -> list[AdjacencyDatabase]:
-    """Three-tier fat-tree fabric (reference: createFabric,
-    RoutingBenchmarkUtils.h:320): per pod, `planes` fabric switches; fsw f
-    uplinks to every spine of plane f and downlinks to every rack switch
-    of its pod.  The reference's 344/1000/5000-switch benchmark fabrics
-    come from scaling pods/rsw_per_pod."""
-    edges: dict[str, list[Adjacency]] = {}
-    for pod in range(pods):
-        for f in range(planes):
-            fsw = f"fsw-{pod}-{f}"
-            edges.setdefault(fsw, [])
-            for s in range(ssw_per_plane):
-                _bidir(edges, fsw, f"ssw-{f}-{s}")
-            for r in range(rsw_per_pod):
-                _bidir(edges, fsw, f"rsw-{pod}-{r}")
-    return _to_dbs(edges, area)
+    """Benchmark-shaped fabric (delegates to fat_tree_topology with one
+    fsw per plane per pod — the reference's 344/1000/5000-switch
+    DecisionBenchmark fabrics scale pods/rsw_per_pod)."""
+    return fat_tree_topology(
+        pods,
+        n_planes=planes,
+        n_fsw_per_pod=planes,
+        n_rsw_per_pod=rsw_per_pod,
+        n_ssw_per_plane=ssw_per_plane,
+        area=area,
+    )
