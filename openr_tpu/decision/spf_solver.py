@@ -1035,6 +1035,28 @@ class SpfSolver:
             route_db = DecisionRouteDb()
             self.best_routes_cache.clear()
 
+            # batched KSP pre-pass: union every KSP2 prefix's advertising
+            # nodes (a superset of the best-route winners) and prefetch
+            # k=1/k=2 for all of them in ONE masked device run per area —
+            # the per-prefix loop then only hits the backend's cache.
+            # Without this, each prefix's miss dispatched its own masked
+            # kernel run (measured: 31 dispatches instead of 1 on the
+            # 32-prefix KSP2 bench).
+            prefetch = getattr(self.spf, "prefetch_kth_paths", None)
+            if prefetch is not None:
+                ksp2_dests: set[str] = set()
+                for entries in prefix_state.prefixes.values():
+                    for (node, _area), entry in entries.items():
+                        if (
+                            entry.forwarding_algorithm
+                            == PrefixForwardingAlgorithm.KSP2_ED_ECMP
+                            and node != me
+                        ):
+                            ksp2_dests.add(node)
+                if ksp2_dests:
+                    for link_state in area_link_states.values():
+                        prefetch(link_state, me, sorted(ksp2_dests))
+
             for prefix in prefix_state.prefixes:
                 route = self.create_route_for_prefix(
                     area_link_states, prefix_state, prefix
