@@ -40,6 +40,7 @@ class PrefixAllocator:
         area: str = "0",
         prefix_updates_queue: Optional[ReplicateQueue[PrefixUpdateRequest]] = None,
         config_store: Optional[PersistentStore] = None,
+        assign_to_interface: str = "",
     ) -> None:
         self.evb = evb
         self.node_name = node_name
@@ -50,6 +51,9 @@ class PrefixAllocator:
         n_prefixes = 1 << (alloc_prefix_len - self.seed.prefixlen)
         self._prefix_updates_queue = prefix_updates_queue
         self.config_store = config_store
+        self.assign_to_interface = assign_to_interface
+        self._assigned_addr: Optional[str] = None  # programmed on iface
+        self._nl = None  # cached NetlinkProtocolSocket (lazy)
         self.my_prefix: Optional[str] = None
         self.range_allocator = RangeAllocator(
             evb,
@@ -79,6 +83,67 @@ class PrefixAllocator:
         base = int(self.seed.network_address) + (index << shift)
         return str(ipaddress.ip_network((base, self.alloc_len)))
 
+    def _sync_iface_addr(self, prefix: Optional[str]) -> None:
+        """Program the elected prefix's first host address onto the
+        configured interface, removing a previously programmed one
+        (reference: PrefixAllocator syncIfaceAddrs — assigns the
+        allocation to the loopback so the node actually owns it).
+        Best-effort: needs CAP_NET_ADMIN; failures are logged, the
+        allocation itself is unaffected."""
+        if not self.assign_to_interface:
+            return
+        new_addr = None
+        if prefix is not None:
+            net = ipaddress.ip_network(prefix)
+            # first host address — except at maximum length, where +1
+            # would land in the NEXT node's allocation (reference adds
+            # +1 only below full length)
+            host = (
+                net.network_address
+                if net.prefixlen == net.network_address.max_prefixlen
+                else net.network_address + 1
+            )
+            new_addr = f"{host}/{net.prefixlen}"
+        if new_addr == self._assigned_addr:
+            return
+        try:
+            if self._nl is None:
+                from ..nl.netlink import NetlinkProtocolSocket
+
+                # one cached socket: per-sync construction would leak the
+                # persistent request fd to GC under allocation churn
+                self._nl = NetlinkProtocolSocket()
+            nl = self._nl
+            if_index = {
+                l.if_name: l.if_index for l in nl.get_all_links()
+            }.get(self.assign_to_interface)
+            if if_index is None:
+                log.warning(
+                    "prefix-allocator: interface %s not found; "
+                    "skipping address assignment",
+                    self.assign_to_interface,
+                )
+                return
+            if self._assigned_addr is not None:
+                try:
+                    nl.del_addr(if_index, self._assigned_addr)
+                except OSError:
+                    pass  # already gone
+                # the old address is off the interface either way; a
+                # failed add below must NOT leave us believing it is
+                # still programmed (that would suppress reprogramming
+                # if the allocation flaps back)
+                self._assigned_addr = None
+            if new_addr is not None:
+                nl.add_addr(if_index, new_addr)
+                self._assigned_addr = new_addr
+        except OSError as exc:
+            log.warning(
+                "prefix-allocator: address sync on %s failed: %s",
+                self.assign_to_interface,
+                exc,
+            )
+
     def _on_allocated(self, index: Optional[int]) -> None:
         if index is None:
             # lost allocation: withdraw
@@ -90,6 +155,7 @@ class PrefixAllocator:
                     )
                 )
             self.my_prefix = None
+            self._sync_iface_addr(None)
             return
         self.my_prefix = self._index_to_prefix(index)
         log.info(
@@ -100,6 +166,7 @@ class PrefixAllocator:
         )
         if self.config_store is not None:
             self.config_store.store(CONFIG_KEY, str(index).encode())
+        self._sync_iface_addr(self.my_prefix)
         if self._prefix_updates_queue is not None:
             self._prefix_updates_queue.push(
                 PrefixUpdateRequest(

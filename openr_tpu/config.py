@@ -74,6 +74,10 @@ class PrefixAllocationConf:
 
     seed_prefix: str = ""
     allocate_prefix_len: int = 128
+    # interface to assign the elected prefix's first address to via
+    # netlink (reference: PrefixAllocator loopback address sync;
+    # set_loopback_address + loopback_interface).  Empty = don't program.
+    assign_to_interface: str = ""
 
 
 @register_type

@@ -266,12 +266,13 @@ class OpenrDaemon:
             self.prefix_allocator = PrefixAllocator(
                 self.link_monitor,
                 self.config.node_name,
-                self.kvstore,
+                self.kvstore_client,
                 pac.seed_prefix,
                 pac.allocate_prefix_len,
                 area=self.config.area_ids[0],
                 prefix_updates_queue=self.prefix_updates_queue,
                 config_store=self.config_store,
+                assign_to_interface=pac.assign_to_interface,
             )
             self.prefix_allocator.start()
 

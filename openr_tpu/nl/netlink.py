@@ -336,6 +336,30 @@ def _parse_neigh(payload: bytes) -> Optional[NeighborInfo]:
     )
 
 
+def build_addr_request(
+    msg_type: int, seq: int, if_index: int, prefix: str
+) -> bytes:
+    """RTM_NEWADDR / RTM_DELADDR for `prefix` (CIDR interface address)
+    on interface `if_index` (reference: NetlinkAddrMessage,
+    openr/nl/NetlinkRoute.h:214 — the PrefixAllocator's address-sync
+    path)."""
+    iface = ipaddress.ip_interface(prefix)
+    family = socket.AF_INET if iface.version == 4 else socket.AF_INET6
+    flags = (
+        NLM_F_REQUEST | NLM_F_ACK | NLM_F_CREATE | NLM_F_REPLACE
+        if msg_type == RTM_NEWADDR
+        else NLM_F_REQUEST | NLM_F_ACK
+    )
+    packed = iface.ip.packed
+    body = (
+        _IFADDRMSG.pack(family, iface.network.prefixlen, 0, 0, if_index)
+        + _rtattr(IFA_LOCAL, packed)
+        + _rtattr(IFA_ADDRESS, packed)
+    )
+    length = _NLMSGHDR.size + len(body)
+    return _NLMSGHDR.pack(length, msg_type, flags, seq, 0) + body
+
+
 def build_route_request(
     msg_type: int, seq: int, route: RouteInfo, flags: Optional[int] = None
 ) -> bytes:
@@ -569,6 +593,22 @@ class NetlinkProtocolSocket(OpenrEventBase):
         self._seq += 1
         self._transact(build_route_request(RTM_DELROUTE, self._seq, route))
         self._bump("netlink.routes_deleted")
+
+    def add_addr(self, if_index: int, prefix: str) -> None:
+        """Assign an interface address (reference: NetlinkAddrMessage /
+        PrefixAllocator address sync)."""
+        self._seq += 1
+        self._transact(
+            build_addr_request(RTM_NEWADDR, self._seq, if_index, prefix)
+        )
+        self._bump("netlink.addrs_added")
+
+    def del_addr(self, if_index: int, prefix: str) -> None:
+        self._seq += 1
+        self._transact(
+            build_addr_request(RTM_DELADDR, self._seq, if_index, prefix)
+        )
+        self._bump("netlink.addrs_deleted")
 
     # -- event subscription --------------------------------------------------
 

@@ -257,3 +257,70 @@ class TestKernelRoutes:
             client.close()
         finally:
             server.stop()
+
+
+@pytest.mark.skipif(not NET_ADMIN, reason="needs NET_ADMIN (veth creation)")
+class TestKernelAddresses:
+    """Interface-address programming (reference: NetlinkAddrMessage,
+    openr/nl/NetlinkRoute.h:214; PrefixAllocator address sync)."""
+
+    @pytest.fixture
+    def veth(self):
+        name = f"ad{uuid.uuid4().hex[:8]}"
+        subprocess.run(
+            ["ip", "link", "add", name, "type", "veth",
+             "peer", "name", f"{name}p"],
+            check=True,
+        )
+        try:
+            subprocess.run(["ip", "link", "set", name, "up"], check=True)
+            yield name
+        finally:
+            subprocess.run(["ip", "link", "del", name], capture_output=True)
+
+    def test_add_read_delete_addr(self, veth):
+        nl = NetlinkProtocolSocket()
+        idx = {l.if_name: l.if_index for l in nl.get_all_links()}[veth]
+        nl.add_addr(idx, "2001:db8:41::1/64")
+        addrs = [
+            a.prefix
+            for a in nl.get_all_addresses()
+            if a.if_index == idx and a.prefix.startswith("2001:db8:41:")
+        ]
+        assert addrs == ["2001:db8:41::1/64"]
+        nl.del_addr(idx, "2001:db8:41::1/64")
+        assert not [
+            a
+            for a in nl.get_all_addresses()
+            if a.if_index == idx and a.prefix.startswith("2001:db8:41:")
+        ]
+
+    def test_prefix_allocator_assigns_address(self, veth):
+        """The allocator's elected prefix lands on the interface and
+        moves when the allocation changes (reference: PrefixAllocator
+        syncIfaceAddrs)."""
+        from openr_tpu.allocators.prefix_allocator import PrefixAllocator
+
+        alloc = PrefixAllocator.__new__(PrefixAllocator)
+        alloc.assign_to_interface = veth
+        alloc._assigned_addr = None
+        alloc._nl = None
+        alloc.node_name = "t"
+        alloc._sync_iface_addr("2001:db8:42:1::/64")
+        nl = NetlinkProtocolSocket()
+        idx = {l.if_name: l.if_index for l in nl.get_all_links()}[veth]
+
+        def mine():
+            return [
+                a.prefix
+                for a in nl.get_all_addresses()
+                if a.if_index == idx and a.prefix.startswith("2001:db8:42:")
+            ]
+
+        assert mine() == ["2001:db8:42:1::1/64"]
+        # allocation moves: old address replaced by the new one
+        alloc._sync_iface_addr("2001:db8:42:2::/64")
+        assert mine() == ["2001:db8:42:2::1/64"]
+        # allocation lost: address withdrawn
+        alloc._sync_iface_addr(None)
+        assert mine() == []

@@ -338,3 +338,47 @@ class TestPrefixAllocator:
             alloc.stop()
             prefixq.close()
             store.close()
+
+
+class TestDaemonPrefixAllocation:
+    def test_daemon_elects_and_advertises_allocation(self):
+        """Prefix allocation through the FULL daemon wiring: the
+        allocator must get the KvStore CLIENT (not the store), elect a
+        subprefix, and the PrefixManager must advertise it (caught live:
+        main.py passed the store and the allocator crashed on start)."""
+        from openr_tpu.config import PrefixAllocationConf
+        from openr_tpu.main import OpenrDaemon
+        from openr_tpu.spark import MockIoProvider
+        from openr_tpu.types import PrefixType
+        from tests.test_system import make_config, wait_for
+
+        cfg = make_config("alloc-d0")
+        cfg.prefix_allocation_config = PrefixAllocationConf(
+            seed_prefix="2001:db8:60::/48", allocate_prefix_len=64
+        )
+        d = OpenrDaemon(
+            cfg,
+            io_provider=MockIoProvider().endpoint("alloc-d0"),
+            spark_v6_addr="::1",
+        )
+        d.start()
+        try:
+            assert wait_for(
+                lambda: d.prefix_allocator is not None
+                and d.prefix_allocator.get_my_prefix() is not None,
+                timeout=20,
+            )
+            prefix = d.prefix_allocator.get_my_prefix()
+            assert prefix.startswith("2001:db8:60:")
+            # advertised through PrefixManager under PREFIX_ALLOCATOR
+            assert wait_for(
+                lambda: any(
+                    e.prefix == prefix
+                    for e in d.prefix_manager.get_prefixes(
+                        PrefixType.PREFIX_ALLOCATOR
+                    )
+                ),
+                timeout=10,
+            )
+        finally:
+            d.stop()
