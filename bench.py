@@ -847,7 +847,8 @@ def _device_child(rows_file: str, skip: set[str]) -> None:
         for name, fn in DEVICE_ROWS.items():
             if name in skip:
                 continue
-            print(f"[device-child] row {name} ...", flush=True)
+            # stderr: the bench contract is ONE JSON line on stdout
+            print(f"[device-child] row {name} ...", file=sys.stderr, flush=True)
             t0 = time.perf_counter()
             try:
                 record = {"row": name, "data": fn(topos)}

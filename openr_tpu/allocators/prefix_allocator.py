@@ -54,6 +54,9 @@ class PrefixAllocator:
         self.assign_to_interface = assign_to_interface
         self._assigned_addr: Optional[str] = None  # programmed on iface
         self._nl = None  # cached NetlinkProtocolSocket (lazy)
+        import threading
+
+        self._addr_sync_lock = threading.Lock()
         self.my_prefix: Optional[str] = None
         self.range_allocator = RangeAllocator(
             evb,
@@ -85,11 +88,14 @@ class PrefixAllocator:
 
     def _sync_iface_addr(self, prefix: Optional[str]) -> None:
         """Program the elected prefix's first host address onto the
-        configured interface, removing a previously programmed one
-        (reference: PrefixAllocator syncIfaceAddrs — assigns the
-        allocation to the loopback so the node actually owns it).
-        Best-effort: needs CAP_NET_ADMIN; failures are logged, the
-        allocation itself is unaffected."""
+        configured interface, removing every OTHER address within the
+        seed prefix (reference: PrefixAllocator syncIfaceAddrs — assigns
+        the allocation to the loopback and reconciles stale addresses a
+        previous process instance may have left behind).  Runs the
+        blocking netlink I/O on a worker thread: the allocator's
+        callbacks fire on the LinkMonitor event base, which must not
+        stall on kernel round-trips.  Best-effort: needs CAP_NET_ADMIN;
+        failures are logged, the allocation itself is unaffected."""
         if not self.assign_to_interface:
             return
         new_addr = None
@@ -104,45 +110,60 @@ class PrefixAllocator:
                 else net.network_address + 1
             )
             new_addr = f"{host}/{net.prefixlen}"
-        if new_addr == self._assigned_addr:
-            return
-        try:
-            if self._nl is None:
-                from ..nl.netlink import NetlinkProtocolSocket
+        import threading
 
-                # one cached socket: per-sync construction would leak the
-                # persistent request fd to GC under allocation churn
-                self._nl = NetlinkProtocolSocket()
-            nl = self._nl
-            if_index = {
-                l.if_name: l.if_index for l in nl.get_all_links()
-            }.get(self.assign_to_interface)
-            if if_index is None:
-                log.warning(
-                    "prefix-allocator: interface %s not found; "
-                    "skipping address assignment",
-                    self.assign_to_interface,
-                )
-                return
-            if self._assigned_addr is not None:
-                try:
-                    nl.del_addr(if_index, self._assigned_addr)
-                except OSError:
-                    pass  # already gone
-                # the old address is off the interface either way; a
-                # failed add below must NOT leave us believing it is
-                # still programmed (that would suppress reprogramming
-                # if the allocation flaps back)
+        threading.Thread(
+            target=self._sync_iface_addr_blocking,
+            args=(new_addr,),
+            name="prefix-alloc-addr-sync",
+            daemon=True,
+        ).start()
+
+    def _sync_iface_addr_blocking(self, new_addr: Optional[str]) -> None:
+        with self._addr_sync_lock:  # serialize racing allocation changes
+            try:
+                if self._nl is None:
+                    from ..nl.netlink import NetlinkProtocolSocket
+
+                    # one cached socket: per-sync construction would leak
+                    # the persistent request fd to GC under churn
+                    self._nl = NetlinkProtocolSocket()
+                nl = self._nl
+                if_index = {
+                    l.if_name: l.if_index for l in nl.get_all_links()
+                }.get(self.assign_to_interface)
+                if if_index is None:
+                    log.warning(
+                        "prefix-allocator: interface %s not found; "
+                        "skipping address assignment",
+                        self.assign_to_interface,
+                    )
+                    return
+                # reconcile: every address on the interface inside the
+                # SEED prefix that is not the current allocation goes —
+                # incl. leftovers from a previous process instance
+                for addr in nl.get_all_addresses():
+                    if addr.if_index != if_index:
+                        continue
+                    try:
+                        ip = ipaddress.ip_interface(addr.prefix).ip
+                    except ValueError:
+                        continue
+                    if ip in self.seed and addr.prefix != new_addr:
+                        try:
+                            nl.del_addr(if_index, addr.prefix)
+                        except OSError:
+                            pass  # already gone
                 self._assigned_addr = None
-            if new_addr is not None:
-                nl.add_addr(if_index, new_addr)
-                self._assigned_addr = new_addr
-        except OSError as exc:
-            log.warning(
-                "prefix-allocator: address sync on %s failed: %s",
-                self.assign_to_interface,
-                exc,
-            )
+                if new_addr is not None:
+                    nl.add_addr(if_index, new_addr)
+                    self._assigned_addr = new_addr
+            except OSError as exc:
+                log.warning(
+                    "prefix-allocator: address sync on %s failed: %s",
+                    self.assign_to_interface,
+                    exc,
+                )
 
     def _on_allocated(self, index: Optional[int]) -> None:
         if index is None:
@@ -185,3 +206,6 @@ class PrefixAllocator:
 
     def stop(self) -> None:
         self.range_allocator.stop()
+        if self._nl is not None:
+            self._nl.close_request_socket()
+            self._nl = None

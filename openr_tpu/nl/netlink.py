@@ -594,6 +594,15 @@ class NetlinkProtocolSocket(OpenrEventBase):
         self._transact(build_route_request(RTM_DELROUTE, self._seq, route))
         self._bump("netlink.routes_deleted")
 
+    def close_request_socket(self) -> None:
+        """Release the persistent request fd (for codec-only users that
+        never run the event base and so never hit stop())."""
+        if self._req_sock is not None:
+            try:
+                self._req_sock.close()
+            finally:
+                self._req_sock = None
+
     def add_addr(self, if_index: int, prefix: str) -> None:
         """Assign an interface address (reference: NetlinkAddrMessage /
         PrefixAllocator address sync)."""

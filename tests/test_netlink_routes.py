@@ -299,16 +299,24 @@ class TestKernelAddresses:
         """The allocator's elected prefix lands on the interface and
         moves when the allocation changes (reference: PrefixAllocator
         syncIfaceAddrs)."""
+        import ipaddress
+        import threading
+        import time
+
         from openr_tpu.allocators.prefix_allocator import PrefixAllocator
 
         alloc = PrefixAllocator.__new__(PrefixAllocator)
         alloc.assign_to_interface = veth
         alloc._assigned_addr = None
         alloc._nl = None
+        alloc._addr_sync_lock = threading.Lock()
+        alloc.seed = ipaddress.ip_network("2001:db8:42::/48")
         alloc.node_name = "t"
-        alloc._sync_iface_addr("2001:db8:42:1::/64")
         nl = NetlinkProtocolSocket()
         idx = {l.if_name: l.if_index for l in nl.get_all_links()}[veth]
+        # a STALE address inside the seed (a previous process instance's
+        # leftover) must be reconciled away by the first sync
+        nl.add_addr(idx, "2001:db8:42:f::1/64")
 
         def mine():
             return [
@@ -317,10 +325,49 @@ class TestKernelAddresses:
                 if a.if_index == idx and a.prefix.startswith("2001:db8:42:")
             ]
 
-        assert mine() == ["2001:db8:42:1::1/64"]
+        def sync_wait(prefix, expect):
+            alloc._sync_iface_addr(prefix)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if mine() == expect:
+                    return
+                time.sleep(0.05)
+            assert mine() == expect
+
+        sync_wait("2001:db8:42:1::/64", ["2001:db8:42:1::1/64"])
         # allocation moves: old address replaced by the new one
-        alloc._sync_iface_addr("2001:db8:42:2::/64")
-        assert mine() == ["2001:db8:42:2::1/64"]
+        sync_wait("2001:db8:42:2::/64", ["2001:db8:42:2::1/64"])
         # allocation lost: address withdrawn
-        alloc._sync_iface_addr(None)
-        assert mine() == []
+        sync_wait(None, [])
+
+
+@pytest.mark.skipif(not NET_ADMIN, reason="needs NET_ADMIN (veth creation)")
+class TestKernelNeighbors:
+    def test_neighbor_dump(self):
+        """RTM_GETNEIGH dump decodes real kernel neighbor entries
+        (reference: NetlinkNeighborMessage, NetlinkRoute.h:255)."""
+        name = f"nb{uuid.uuid4().hex[:8]}"
+        subprocess.run(
+            ["ip", "link", "add", name, "type", "veth",
+             "peer", "name", f"{name}p"],
+            check=True,
+        )
+        try:
+            subprocess.run(["ip", "link", "set", name, "up"], check=True)
+            subprocess.run(
+                ["ip", "neigh", "add", "2001:db8:fe::99",
+                 "lladdr", "02:00:00:00:00:01", "dev", name],
+                check=True,
+            )
+            nl = NetlinkProtocolSocket()
+            idx = {l.if_name: l.if_index for l in nl.get_all_links()}[name]
+            mine = [
+                n
+                for n in nl.get_all_neighbors()
+                if n.if_index == idx and n.dst == "2001:db8:fe::99"
+            ]
+            assert len(mine) == 1
+            assert mine[0].lladdr == "02:00:00:00:00:01"
+            assert mine[0].family == socket.AF_INET6
+        finally:
+            subprocess.run(["ip", "link", "del", name], capture_output=True)
