@@ -53,10 +53,16 @@ class PrefixAllocator:
         self.config_store = config_store
         self.assign_to_interface = assign_to_interface
         self._assigned_addr: Optional[str] = None  # programmed on iface
+        self._addr_reconciled = False  # stale-cleanup sweep done once
         self._nl = None  # cached NetlinkProtocolSocket (lazy)
         import threading
 
         self._addr_sync_lock = threading.Lock()
+        # latest-wins mailbox for the single sync worker (see
+        # _sync_iface_addr); a 1-tuple so pending None is distinguishable
+        self._addr_pending: Optional[tuple] = None
+        self._addr_worker_busy = False
+        self._addr_stopped = False
         self.my_prefix: Optional[str] = None
         self.range_allocator = RangeAllocator(
             evb,
@@ -112,58 +118,84 @@ class PrefixAllocator:
             new_addr = f"{host}/{net.prefixlen}"
         import threading
 
+        with self._addr_sync_lock:
+            if self._addr_stopped:
+                return
+            # latest wins: a superseded request must never be applied
+            # AFTER its successor (thread-per-call could reorder)
+            self._addr_pending = (new_addr,)
+            if self._addr_worker_busy:
+                return  # the running worker drains the mailbox
+            self._addr_worker_busy = True
         threading.Thread(
-            target=self._sync_iface_addr_blocking,
-            args=(new_addr,),
+            target=self._addr_sync_worker,
             name="prefix-alloc-addr-sync",
             daemon=True,
         ).start()
 
-    def _sync_iface_addr_blocking(self, new_addr: Optional[str]) -> None:
-        with self._addr_sync_lock:  # serialize racing allocation changes
-            try:
-                if self._nl is None:
-                    from ..nl.netlink import NetlinkProtocolSocket
-
-                    # one cached socket: per-sync construction would leak
-                    # the persistent request fd to GC under churn
-                    self._nl = NetlinkProtocolSocket()
-                nl = self._nl
-                if_index = {
-                    l.if_name: l.if_index for l in nl.get_all_links()
-                }.get(self.assign_to_interface)
-                if if_index is None:
-                    log.warning(
-                        "prefix-allocator: interface %s not found; "
-                        "skipping address assignment",
-                        self.assign_to_interface,
-                    )
+    def _addr_sync_worker(self) -> None:
+        """Single drainer: applies the LATEST pending address; the
+        netlink socket is touched only here and released on exit when
+        stop() raced us."""
+        while True:
+            with self._addr_sync_lock:
+                if self._addr_pending is None or self._addr_stopped:
+                    self._addr_worker_busy = False
+                    if self._addr_stopped and self._nl is not None:
+                        self._nl.close_request_socket()
+                        self._nl = None
                     return
-                # reconcile: every address on the interface inside the
-                # SEED prefix that is not the current allocation goes —
-                # incl. leftovers from a previous process instance
-                for addr in nl.get_all_addresses():
-                    if addr.if_index != if_index:
-                        continue
-                    try:
-                        ip = ipaddress.ip_interface(addr.prefix).ip
-                    except ValueError:
-                        continue
-                    if ip in self.seed and addr.prefix != new_addr:
-                        try:
-                            nl.del_addr(if_index, addr.prefix)
-                        except OSError:
-                            pass  # already gone
-                self._assigned_addr = None
-                if new_addr is not None:
-                    nl.add_addr(if_index, new_addr)
-                    self._assigned_addr = new_addr
-            except OSError as exc:
+                (new_addr,) = self._addr_pending
+                self._addr_pending = None
+            self._apply_iface_addr(new_addr)
+
+    def _apply_iface_addr(self, new_addr: Optional[str]) -> None:
+        if new_addr == self._assigned_addr and self._addr_reconciled:
+            return  # no-op re-fire: skip the kernel dumps
+        try:
+            if self._nl is None:
+                from ..nl.netlink import NetlinkProtocolSocket
+
+                # one cached socket: per-sync construction would leak
+                # the persistent request fd to GC under churn
+                self._nl = NetlinkProtocolSocket()
+            nl = self._nl
+            if_index = {
+                l.if_name: l.if_index for l in nl.get_all_links()
+            }.get(self.assign_to_interface)
+            if if_index is None:
                 log.warning(
-                    "prefix-allocator: address sync on %s failed: %s",
+                    "prefix-allocator: interface %s not found; "
+                    "skipping address assignment",
                     self.assign_to_interface,
-                    exc,
                 )
+                return
+            # reconcile: every address on the interface inside the SEED
+            # prefix that is not the current allocation goes — incl.
+            # leftovers from a previous process instance
+            for addr in nl.get_all_addresses():
+                if addr.if_index != if_index:
+                    continue
+                try:
+                    ip = ipaddress.ip_interface(addr.prefix).ip
+                except ValueError:
+                    continue
+                if ip in self.seed and addr.prefix != new_addr:
+                    try:
+                        nl.del_addr(if_index, addr.prefix)
+                    except OSError:
+                        pass  # already gone
+            self._assigned_addr = None
+            if new_addr is not None:
+                nl.add_addr(if_index, new_addr)
+                self._assigned_addr = new_addr
+            self._addr_reconciled = True
+        except OSError as exc:
+            log.warning(
+                "prefix-allocator: address sync on %s failed: %s",
+                self.assign_to_interface,
+                exc,
+            )
 
     def _on_allocated(self, index: Optional[int]) -> None:
         if index is None:
@@ -206,6 +238,11 @@ class PrefixAllocator:
 
     def stop(self) -> None:
         self.range_allocator.stop()
-        if self._nl is not None:
-            self._nl.close_request_socket()
-            self._nl = None
+        with self._addr_sync_lock:
+            self._addr_stopped = True
+            self._addr_pending = None
+            # a busy worker owns the socket and closes it on exit; only
+            # reclaim it here when no worker is running
+            if not self._addr_worker_busy and self._nl is not None:
+                self._nl.close_request_socket()
+                self._nl = None

@@ -1,0 +1,26 @@
+#!/bin/bash
+# Launch wrapper for the openr-tpu daemon under a supervisor
+# (reference: openr/scripts/run_openr.sh — sources an env file of
+# OPENR_* overrides, then execs the daemon so signals pass through).
+#
+# Usage: run_openr_tpu.sh [/etc/sysconfig/openr-tpu]
+#
+# The env file may set:
+#   OPENR_CONFIG   path to the JSON config (default /etc/openr-tpu.conf)
+#   OPENR_ARGS     extra daemon flags (flags override config fields)
+
+set -eu
+
+ENV_FILE="${1:-/etc/sysconfig/openr-tpu}"
+if [ -f "$ENV_FILE" ]; then
+    # shellcheck disable=SC1090
+    . "$ENV_FILE"
+fi
+
+OPENR_CONFIG="${OPENR_CONFIG:-/etc/openr-tpu.conf}"
+OPENR_ARGS="${OPENR_ARGS:-}"
+
+# exec: the supervisor's signals (systemd stop, watchdog restart) must
+# reach the daemon, not this wrapper
+# shellcheck disable=SC2086
+exec openr-tpu --config "$OPENR_CONFIG" $OPENR_ARGS

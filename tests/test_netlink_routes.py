@@ -308,8 +308,12 @@ class TestKernelAddresses:
         alloc = PrefixAllocator.__new__(PrefixAllocator)
         alloc.assign_to_interface = veth
         alloc._assigned_addr = None
+        alloc._addr_reconciled = False
         alloc._nl = None
         alloc._addr_sync_lock = threading.Lock()
+        alloc._addr_pending = None
+        alloc._addr_worker_busy = False
+        alloc._addr_stopped = False
         alloc.seed = ipaddress.ip_network("2001:db8:42::/48")
         alloc.node_name = "t"
         nl = NetlinkProtocolSocket()
