@@ -431,6 +431,7 @@ def bench_tilfa(topo, source: int, reps: int) -> dict:
             topo.node_overloaded,
             rev_full,
             max_degree=len(out_edges),
+            ell=topo.ell,
         )
 
     # parity: each row vs C++ with that edge pair down
@@ -470,6 +471,7 @@ def bench_tilfa(topo, source: int, reps: int) -> dict:
                 topo.node_overloaded,
                 rev_full,
                 max_degree=len(out_edges),
+                ell=topo.ell,
             )
         ),
         runs=3,

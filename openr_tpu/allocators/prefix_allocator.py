@@ -52,8 +52,6 @@ class PrefixAllocator:
         self._prefix_updates_queue = prefix_updates_queue
         self.config_store = config_store
         self.assign_to_interface = assign_to_interface
-        self._assigned_addr: Optional[str] = None  # programmed on iface
-        self._addr_reconciled = False  # stale-cleanup sweep done once
         self._nl = None  # cached NetlinkProtocolSocket (lazy)
         import threading
 
@@ -147,11 +145,19 @@ class PrefixAllocator:
                     return
                 (new_addr,) = self._addr_pending
                 self._addr_pending = None
-            self._apply_iface_addr(new_addr)
+            try:
+                self._apply_iface_addr(new_addr)
+            except Exception:
+                # the worker must survive ANY failure: dying here would
+                # strand _addr_worker_busy=True and wedge every future
+                # sync (and stop() would never reclaim the socket)
+                log.exception("prefix-allocator: address sync failed")
 
     def _apply_iface_addr(self, new_addr: Optional[str]) -> None:
-        if new_addr == self._assigned_addr and self._addr_reconciled:
-            return  # no-op re-fire: skip the kernel dumps
+        # no same-value short-circuit: every sync reconciles against the
+        # KERNEL's actual state, so a flapped interface (link down/up
+        # flushes addresses) or operator deletion self-heals on the next
+        # allocator callback
         try:
             if self._nl is None:
                 from ..nl.netlink import NetlinkProtocolSocket
@@ -185,11 +191,8 @@ class PrefixAllocator:
                         nl.del_addr(if_index, addr.prefix)
                     except OSError:
                         pass  # already gone
-            self._assigned_addr = None
             if new_addr is not None:
                 nl.add_addr(if_index, new_addr)
-                self._assigned_addr = new_addr
-            self._addr_reconciled = True
         except OSError as exc:
             log.warning(
                 "prefix-allocator: address sync on %s failed: %s",

@@ -102,6 +102,7 @@ def what_if(
         csr.edge_up,
         csr.node_overloaded,
         masks,
+        ell=csr.ell,
     )
     # restrict impact counting to real nodes (padding cols are unreachable
     # in baseline too, so they never count, but be explicit)
@@ -171,6 +172,7 @@ def ti_lfa(
         csr.node_overloaded,
         rev_full,
         max_degree=len(out_edges) + 1,
+        ell=csr.ell,
     )
     dist = np.asarray(dist)  # [D+1, N_cap]
     dag = np.asarray(dag)  # [D+1, E_cap]

@@ -307,8 +307,6 @@ class TestKernelAddresses:
 
         alloc = PrefixAllocator.__new__(PrefixAllocator)
         alloc.assign_to_interface = veth
-        alloc._assigned_addr = None
-        alloc._addr_reconciled = False
         alloc._nl = None
         alloc._addr_sync_lock = threading.Lock()
         alloc._addr_pending = None
