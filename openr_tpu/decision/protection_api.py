@@ -64,8 +64,10 @@ def what_if(
         source_names = [s for s in sources if s in csr.node_id]
     if not source_names or not scenarios:
         return []
-    total = (
-        (len(scenarios) + 1) * len(source_names) * csr.node_capacity
+    # budget BOTH the [F*S, N_cap] distance output and the [F*S, E_cap]
+    # per-row exclusion masks the ELL path materializes
+    total = (len(scenarios) + 1) * len(source_names) * (
+        csr.node_capacity + csr.edge_capacity
     )
     if total > _WHAT_IF_MAX_ELEMENTS:
         raise ValueError(

@@ -57,29 +57,21 @@ def srlg_what_if(
     Distances only: the SP-DAG nobody reads here is never built."""
     n_nodes = node_overloaded.shape[0]
     if ell is not None:
-        from .sssp import (
-            batched_sssp_ell,
-            ell_dist_to_old_T,
-            make_dist0_T,
-            make_relax_allowed_T,
-        )
-
-        f_dim, e_dim = scenario_masks.shape
+        f_dim = scenario_masks.shape[0]
         s_dim = sources.shape[0]
         flat_sources = jnp.tile(sources, f_dim)  # [F*S]
         flat_masks = jnp.repeat(scenario_masks, s_dim, axis=0)  # [F*S, E]
-        allowed_T = make_relax_allowed_T(
-            flat_sources, edge_src, edge_up, node_overloaded, flat_masks.T
-        )
-        dist_T = batched_sssp_ell(
-            make_dist0_T(flat_sources, ell.new_of_old, n_nodes),
+        dist, _ = spf_forward_ell_masked(
+            flat_sources,
             ell,
-            row_allowed_T=allowed_T,
-            edge_up=edge_up,
-            node_overloaded=node_overloaded,
-            edge_metric=edge_metric,
+            edge_src,
+            edge_dst,
+            edge_metric,
+            edge_up,
+            node_overloaded,
+            flat_masks,
+            want_dag=False,
         )
-        dist = ell_dist_to_old_T(dist_T, ell).T
         return dist.reshape(f_dim, s_dim, n_nodes)
     base_allowed = make_relax_allowed(
         sources, edge_src, edge_up, node_overloaded

@@ -461,7 +461,9 @@ def spf_forward_ell(
     return dist_old_T.T, dag
 
 
-@functools.partial(jax.jit, static_argnames=("use_link_metric",))
+@functools.partial(
+    jax.jit, static_argnames=("use_link_metric", "want_dag")
+)
 def spf_forward_ell_masked(
     sources: jax.Array,
     ell: EllGraph,
@@ -472,10 +474,13 @@ def spf_forward_ell_masked(
     node_overloaded: jax.Array,
     extra_edge_mask: jax.Array,  # [S, E_cap] or [E_cap] bool, False = exclude
     use_link_metric: bool = True,
+    want_dag: bool = True,
 ) -> tuple[jax.Array, jax.Array]:
     """ELL forward with per-row edge exclusions (KSP re-runs, SRLG
     what-if).  The [S, E] mask is materialized — callers batch many
-    variants, so S is the what-if dimension here."""
+    variants, so S is the what-if dimension here.  With want_dag=False
+    only distances are computed/returned (dist, None) — the what-if
+    reachability analysis never reads the DAG."""
     n_cap = node_overloaded.shape[0]
     extra_T = (
         extra_edge_mask.T if extra_edge_mask.ndim == 2 else extra_edge_mask
@@ -493,6 +498,8 @@ def spf_forward_ell_masked(
         edge_metric=edge_metric,
     )
     dist_old_T = ell_dist_to_old_T(dist_T, ell)
+    if not want_dag:
+        return dist_old_T.T, None
     metric = edge_metric if use_link_metric else jnp.ones_like(edge_metric)
     dag = sp_dag_mask_from_T(dist_old_T, edge_src, edge_dst, metric, allowed_T)
     return dist_old_T.T, dag
