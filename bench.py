@@ -838,9 +838,15 @@ DEVICE_NOTES = [
     "retained above; p50/p95 reported for the latency-sensitive rows",
     "device_ms_amortized: per-run time with the flat per-dispatch "
     "tunnel fee divided out — R rotated-input runs inside ONE "
-    "dispatch, (T_R - T_1)/(R-1).  This is the sustained per-question "
-    "cost production batching achieves; wall numbers (device_ms_min) "
-    "are reported alongside and still include the fee",
+    "dispatch, (T_R - T_1)/(R-1), null when the latency windows "
+    "flipped against the estimator.  This is the sustained "
+    "per-question cost production batching achieves; wall numbers "
+    "(device_ms_min) are reported alongside and still include the fee",
+    "reconverge_flap/ksp2 are host+device END-TO-END pipelines whose "
+    "single small dispatch pays the full tunnel fee, so the host "
+    "backend wins their WALL time at 1k-node scale; see "
+    "docs/TPU_DESIGN.md 'Host/device crossover' for the analysis and "
+    "the production batching posture",
 ]
 
 
