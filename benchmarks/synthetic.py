@@ -33,6 +33,27 @@ class Topology:
     edge_up: np.ndarray  # [E_cap] bool
     node_overloaded: np.ndarray  # [N_cap] bool
     ell: object = None
+    banded: object = None  # ops.banded.BandedGraph | None
+    _runner: object = None
+
+    @property
+    def runner(self):
+        """Lazy ops.banded.SpfRunner — the production fixed-sweep
+        execution path (band-aware kernel dispatch + adaptive hints)."""
+        if self._runner is None:
+            from openr_tpu.ops.banded import SpfRunner
+
+            self._runner = SpfRunner(
+                self.ell,
+                self.banded,
+                self.edge_src,
+                self.edge_dst,
+                self.edge_metric,
+                self.edge_up,
+                self.node_overloaded,
+                self.n_edges,
+            )
+        return self._runner
 
     @classmethod
     def from_links(
@@ -40,6 +61,7 @@ class Topology:
     ) -> "Topology":
         """links [L, 2] int32 undirected, metrics [L] (or [L, 2] for
         asymmetric per-direction metrics)."""
+        from openr_tpu.ops.banded import build_banded
         from openr_tpu.ops.sssp import build_ell
 
         if metrics.ndim == 1:
@@ -67,6 +89,7 @@ class Topology:
         ell = build_ell(
             edge_src, edge_dst, edge_metric, edge_up, node_overloaded, e
         )
+        banded = build_banded(edge_src, edge_dst, e, n_nodes)
         return cls(
             name=name,
             n_nodes=n_nodes,
@@ -79,6 +102,7 @@ class Topology:
             edge_up=edge_up,
             node_overloaded=node_overloaded,
             ell=ell,
+            banded=banded,
         )
 
 
@@ -155,6 +179,48 @@ def wan(n_nodes: int = 100_000, chords: int = 2, seed: int = 0) -> Topology:
     links = links[keep]
     metrics = rng.randint(1, 11, size=(len(links), 2)).astype(np.int32)
     return Topology.from_links(f"wan{n_nodes}", n_nodes, links, metrics)
+
+
+def reversed_topology(topo: Topology) -> Topology:
+    """Same nodes, every directed edge reversed (per-direction metrics
+    travel with their edge) — the graph on which P-source SSSP computes
+    all-sources-to-P-destinations distances (ops.allsources)."""
+    from openr_tpu.ops.banded import build_banded
+    from openr_tpu.ops.sssp import build_ell
+
+    e = topo.n_edges
+    src = topo.edge_dst[:e].copy()
+    dst = topo.edge_src[:e].copy()
+    met = topo.edge_metric[:e].copy()
+    order = np.lexsort((src, dst))
+    pad_node = topo.node_capacity - 1
+    edge_src = np.full(topo.edge_capacity, pad_node, dtype=np.int32)
+    edge_dst = np.full(topo.edge_capacity, pad_node, dtype=np.int32)
+    edge_metric = np.ones(topo.edge_capacity, dtype=np.int32)
+    edge_up = np.zeros(topo.edge_capacity, dtype=bool)
+    edge_src[:e] = src[order]
+    edge_dst[:e] = dst[order]
+    edge_metric[:e] = met[order]
+    edge_up[:e] = topo.edge_up[:e][order]
+    node_overloaded = topo.node_overloaded.copy()
+    ell = build_ell(
+        edge_src, edge_dst, edge_metric, edge_up, node_overloaded, e
+    )
+    banded = build_banded(edge_src, edge_dst, e, topo.n_nodes)
+    return Topology(
+        name=topo.name + "-rev",
+        n_nodes=topo.n_nodes,
+        n_edges=e,
+        node_capacity=topo.node_capacity,
+        edge_capacity=topo.edge_capacity,
+        edge_src=edge_src,
+        edge_dst=edge_dst,
+        edge_metric=edge_metric,
+        edge_up=edge_up,
+        node_overloaded=node_overloaded,
+        ell=ell,
+        banded=banded,
+    )
 
 
 def neighbors_of(topo: Topology, node: int) -> np.ndarray:

@@ -87,6 +87,32 @@ class CsrTopology:
     # adaptive fixed-sweep hint for the relax loops (see spf_from); grows
     # by doubling when a run fails to reach the fixed point
     _sweep_hint: int = 16
+    # circulant-band decomposition (ops.banded.BandedGraph) — present when
+    # the topology has band structure; drives the banded relax kernel
+    banded: object = None
+    _runner: object = None
+
+    @property
+    def runner(self):
+        """ops.banded.SpfRunner over this mirror: band-aware fixed-sweep
+        execution for dist/dag batches (KSP re-runs, what-if, TI-LFA).
+        Reads the SAME numpy arrays the mirror refreshes in place, so
+        attribute-only refreshes need no runner rebuild."""
+        if self._runner is None:
+            from ..ops.banded import SpfRunner
+
+            self._runner = SpfRunner(
+                self.ell,
+                self.banded,
+                self.edge_src,
+                self.edge_dst,
+                self.edge_metric,
+                self.edge_up,
+                self.node_overloaded,
+                self.n_edges,
+            )
+        return self._runner
+
     # -- construction -------------------------------------------------------
 
     @classmethod
@@ -141,11 +167,13 @@ class CsrTopology:
         for name, i in node_id.items():
             node_overloaded[i] = ls.is_node_overloaded(name)
 
+        from ..ops.banded import build_banded
         from ..ops.sssp import build_ell
 
         ell = build_ell(
             edge_src, edge_dst, edge_metric, edge_up, node_overloaded, e
         )
+        banded = build_banded(edge_src, edge_dst, e, n)
         out_slot, max_out_slots = _build_out_slots(edge_src, edge_dst, e)
 
         return cls(
@@ -163,6 +191,7 @@ class CsrTopology:
             n_edges=e,
             version=ls.version,
             ell=ell,
+            banded=banded,
             out_slot=out_slot,
             max_out_slots=max_out_slots,
         )
@@ -230,37 +259,20 @@ class CsrTopology:
         use_link_metric: bool = True,
         extra_edge_mask: Optional[np.ndarray] = None,
     ) -> tuple[np.ndarray, np.ndarray]:
-        """Run the device kernel (bucketed-ELL relaxation); returns
-        (dist [S, N_cap], dag [S, E_cap]) as numpy."""
-        from ..ops import sssp as ops
-
+        """Run the device kernel (band-aware fixed-sweep relaxation);
+        returns (dist [S, N*], dag [S, E_cap]) as numpy.  N* is n_nodes
+        on the banded path and node_capacity on the ELL path — consumers
+        index [: n_nodes] either way."""
         src_ids = np.asarray(
             [self.node_id[s] for s in sources], dtype=np.int32
         )
-        if extra_edge_mask is None:
-            dist, dag = ops.spf_forward_ell(
-                src_ids,
-                self.ell,
-                self.edge_src,
-                self.edge_dst,
-                self.edge_metric,
-                self.edge_up,
-                self.node_overloaded,
-                use_link_metric=use_link_metric,
-            )
-        else:
-            dist, dag = ops.spf_forward_ell_masked(
-                src_ids,
-                self.ell,
-                self.edge_src,
-                self.edge_dst,
-                self.edge_metric,
-                self.edge_up,
-                self.node_overloaded,
-                np.asarray(extra_edge_mask),
-                use_link_metric=use_link_metric,
-            )
-        return np.asarray(dist), np.asarray(dag)
+        return self.runner.forward(
+            src_ids,
+            use_link_metric=use_link_metric,
+            extra_edge_mask=(
+                None if extra_edge_mask is None else np.asarray(extra_edge_mask)
+            ),
+        )
 
     # -- result reconstruction (parity with the host oracle) ----------------
 

@@ -443,6 +443,12 @@ class KvStorePeer:
     # completion does not re-fire initialization signaling (see
     # anti_entropy_sync / process_sync_success)
     anti_entropy_pending: bool = False
+    # set when the DUAL outbox overflowed while this peer stayed up: a
+    # dropped message means our DUAL exchange with it is no longer
+    # complete, so once the backlog drains the drainer bounces DUAL state
+    # for this peer (advisor r3 — reconnect-time reconciliation alone
+    # never fires for a slow-but-alive peer)
+    dual_reconcile_needed: bool = False
     # whether this peer has ever spoken DUAL to us.  A flood-opt-disabled
     # peer never does, and must keep receiving full-mesh floods even once
     # our SPT is valid — otherwise a mixed-config mesh silently starves it.
@@ -514,7 +520,19 @@ class KvStoreDb:
         reference got from its ordered ZMQ peer channel, with a bounded
         backlog: new work enqueued while draining is picked up by the
         running drainer, so an unreachable peer holds at most
-        DUAL_SEND_BACKLOG_MAX messages + one pending topo-set per root."""
+        DUAL_SEND_BACKLOG_MAX messages + one pending topo-set per root.
+
+        INTENTIONAL reorder vs the reference's single FIFO channel:
+        pending topo-sets are serviced ahead of queued DUAL messages.
+        Topo-sets are idempotent FINAL-STATE registrations (child
+        add/remove — processFloodTopoSet is state-independent in the
+        reference too), so delivering one ahead of an older DUAL message
+        cannot corrupt the exchange, and servicing them first keeps the
+        SPT attach latency independent of DUAL backlog depth.  Starvation
+        is bounded: topo-sets coalesce by (root, all_roots) key, so the
+        map holds at most one entry per root and only sustained nexthop
+        flapping could re-fill it — at which point attaching to the
+        latest parent IS the priority."""
         if peer.send_lock.locked():
             return  # a drainer is running; it will see the new work
         async with peer.send_lock:
@@ -551,6 +569,17 @@ class KvStoreDb:
                         if peer.outbox and peer.outbox[0] is entry:
                             peer.outbox.popleft()
 
+                elif peer.dual_reconcile_needed:
+                    # backlog drained after an overflow drop: bounce DUAL
+                    # state with this (live) peer so whatever the dropped
+                    # message carried is regenerated from a clean slate.
+                    # peer_down/peer_up enqueue fresh messages into this
+                    # same outbox; the loop delivers them next.
+                    peer.dual_reconcile_needed = False
+                    self._bump("kvstore.dual.num_overflow_reconcile")
+                    self.dual.peer_down(peer.name)
+                    self.dual.peer_up(peer.name, 1)
+                    continue
                 else:
                     return
                 try:
@@ -573,7 +602,11 @@ class KvStoreDb:
 
     def _dual_to_peer(self, peer: KvStorePeer, msgs) -> None:
         if len(peer.outbox) >= DUAL_SEND_BACKLOG_MAX:
-            peer.outbox.popleft()  # drop oldest; reconciled on reconnect
+            # drop oldest; a live peer is reconciled by the drainer once
+            # the backlog clears (dual_reconcile_needed), a dead one by
+            # the reconnect-time peer_down/peer_up
+            peer.outbox.popleft()
+            peer.dual_reconcile_needed = True
             self._bump("kvstore.dual.num_pkt_backlog_dropped")
 
         async def send_once():

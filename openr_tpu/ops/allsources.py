@@ -1,0 +1,192 @@
+"""Reduced-output all-sources SPF: the product route building consumes.
+
+The literal all-sources [N, N] distance matrix at 100k nodes is 40 GB —
+un-materializable on one chip, and nobody reads it: the reference's
+buildRouteDb consumes, per router, only the distances/next-hops toward
+the P prefix-originating nodes (openr/decision/Decision.cpp:615-793
+createRouteForPrefix reads best-entry node distances; getNextHopsThrift's
+LFA-free ECMP keeps neighbor u for destination t iff
+metric(v,u) + dist(u,t) == dist(v,t), Decision.cpp:1296-1300).
+
+So the whole-fleet product is all-sources-to-P-destinations, and on the
+reversed graph that is ONE P-source SSSP:
+
+    dist(v -> p)  ==  reverse-SSSP from p over reversed edges, read at v.
+
+Drain semantics survive reversal exactly: the kernel blocks relaxation
+through an overloaded predecessor unless its distance is 0 (ops.sssp /
+ops.banded).  On the reversed graph the d==0 exception lands on the
+original DESTINATION p (whose original in-edges are always usable), and
+an overloaded original source v is reached by a final reverse hop whose
+predecessor is v's neighbor — never blocked — while overloaded
+intermediates still block as reverse-edge tails.  A case-by-case check
+of (source, intermediate, destination) overload shows equality with the
+forward rule; tests/test_banded.py (TestReducedAllSources) asserts it against the oracle.
+
+The fused consumer pass then emits, per (router v, destination p), the
+bit-packed ECMP next-hop set straight from the reverse distances —
+gathers over a per-node out-neighbor table, no scatters — so the entire
+fleet-wide route-building input is ONE device call returning
+[N, P] int32 distances + [N, P, W] uint32 next-hop bitmaps.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .sssp import INF32
+
+
+class OutEll(NamedTuple):
+    """Per-node out-edge table in original node order (host-built)."""
+
+    nbr: jax.Array  # [N, K] int32 — out-neighbor node id (pad 0)
+    eid: jax.Array  # [N, K] int32 — directed edge id; -1 pad
+    slot: jax.Array  # [N, K] int32 — rank among the node's sorted unique
+    #   out-neighbors (parallel links share a slot); -1 pad
+    n_words: int  # ceil(max_slots / 32) — static
+
+
+def build_out_ell(
+    edge_src: np.ndarray,
+    edge_dst: np.ndarray,
+    n_edges: int,
+    n_nodes: int,
+    out_slot: Optional[np.ndarray] = None,
+) -> OutEll:
+    """Vectorized out-edge table build.  `out_slot` (per-edge slot ids,
+    csr._build_out_slots layout) is recomputed here when not supplied."""
+    src = np.asarray(edge_src[:n_edges], dtype=np.int64)
+    dst = np.asarray(edge_dst[:n_edges], dtype=np.int64)
+    if out_slot is None:
+        from ..decision.csr import _build_out_slots
+
+        out_slot, _ = _build_out_slots(
+            np.asarray(edge_src), np.asarray(edge_dst), n_edges
+        )
+    deg = np.bincount(src, minlength=n_nodes)
+    k = int(deg.max()) if n_edges else 1
+    k_pad = 1
+    while k_pad < max(k, 1):
+        k_pad *= 2
+    order = np.argsort(src, kind="stable")
+    e_sorted = order
+    s_sorted = src[order]
+    starts = np.searchsorted(s_sorted, np.arange(n_nodes))
+    pos = np.arange(len(order)) - starts[s_sorted]
+    nbr = np.zeros((n_nodes, k_pad), dtype=np.int32)
+    eid = np.full((n_nodes, k_pad), -1, dtype=np.int32)
+    slot = np.full((n_nodes, k_pad), -1, dtype=np.int32)
+    nbr[s_sorted, pos] = dst[e_sorted].astype(np.int32)
+    eid[s_sorted, pos] = e_sorted.astype(np.int32)
+    slot[s_sorted, pos] = out_slot[:n_edges][e_sorted]
+    max_slots = int(out_slot[:n_edges].max()) + 1 if n_edges else 1
+    return OutEll(
+        nbr=jnp.asarray(nbr),
+        eid=jnp.asarray(eid),
+        slot=jnp.asarray(slot),
+        n_words=max(1, -(-max_slots // 32)),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("n_words",))
+def ecmp_bitmap_from_reverse_dist(
+    drev: jax.Array,  # [P, N*] int32 — reverse-SSSP distances (dist(v->p));
+    #   N* is n_nodes (banded kernel) or node_capacity (ELL fallback)
+    out: OutEll,
+    edge_metric: jax.Array,  # [E_cap] int32
+    edge_up: jax.Array,  # [E_cap] bool
+    node_overloaded: jax.Array,  # [N_cap] bool
+    n_words: int,
+) -> jax.Array:
+    """[N, P, W] uint32: bit s of (v, p) set iff out-slot s of router v
+    is an ECMP next-hop toward destination p — the reference's LFA-free
+    condition metric(v,u) + dist(u,p) == dist(v,p)
+    (openr/decision/Decision.cpp:1296-1300), evaluated fleet-wide from
+    reverse distances.  Gather-only.
+
+    Drain: the reference draws ECMP neighbors from the source's
+    drain-respecting SPF tree (nextHopNodes is keyed by
+    shortestPathsFromHere nextHops, Decision.cpp:1182-1260), so an
+    overloaded neighbor u is a valid next-hop ONLY as the destination
+    itself — the same own-source/destination exception the relax kernels
+    encode, here as d(u,p) == 0."""
+    n, k_pad = out.nbr.shape
+    drev_T = drev.T  # [N*, P]
+    p_dim = drev.shape[0]
+    bitmap = jnp.zeros((n, p_dim, n_words), dtype=jnp.uint32)
+    d_self = drev_T[:n]  # [N, P]
+    for k in range(k_pad):
+        eidk = out.eid[:, k]
+        ok = (eidk >= 0) & jnp.take(edge_up, jnp.maximum(eidk, 0))
+        w = jnp.take(edge_metric, jnp.maximum(eidk, 0))  # [N]
+        nbr = out.nbr[:, k]
+        d_nbr = jnp.take(drev_T, nbr, axis=0)  # [N, P]
+        nbr_ov = jnp.take(node_overloaded, nbr)  # [N]
+        on = (
+            ok[:, None]
+            & (d_nbr < INF32)
+            & (d_nbr + w[:, None] == d_self)
+            & (~nbr_ov[:, None] | (d_nbr == 0))
+        )  # [N, P]
+        slot = out.slot[:, k]
+        bit = jnp.where(
+            slot >= 0,
+            jnp.uint32(1) << (jnp.maximum(slot, 0) % 32).astype(jnp.uint32),
+            jnp.uint32(0),
+        )  # [N]
+        word_sel = (jnp.maximum(slot, 0) // 32)[:, None] == jnp.arange(
+            n_words
+        )[None, :]  # [N, W]
+        contrib = jnp.where(
+            on[:, :, None] & word_sel[:, None, :],
+            bit[:, None, None],
+            jnp.uint32(0),
+        )
+        bitmap = bitmap | contrib
+    return bitmap
+
+
+def reduced_all_sources(
+    dest_ids,
+    reverse_runner,
+    out: OutEll,
+    edge_metric,
+    edge_up,
+    node_overloaded,
+    n_sweeps: Optional[int] = None,
+):
+    """Fleet-wide route-building input in one device round:
+    (dist [P, N*] int32 jax — dist[p, v] = dist(v -> p), nh_bitmap
+    [N, P, W] uint32 jax, converged bool).
+
+    `reverse_runner` is an ops.banded.SpfRunner over the REVERSED edge
+    arrays (benchmarks.synthetic.reversed_topology / csr mirror).  With
+    `n_sweeps` the call is non-adaptive (bench timing; caller asserts
+    convergence).  Adaptive mode doubles the runner's hint on a False
+    verdict without re-running converged work — the distances of the
+    converged attempt feed the bitmap pass directly."""
+    import numpy as _np
+
+    dest_ids = jnp.asarray(_np.asarray(dest_ids, dtype=_np.int32))
+    while True:
+        sweeps = n_sweeps if n_sweeps is not None else reverse_runner.hint
+        dist, _, ok = reverse_runner.run_once(
+            dest_ids, sweeps, want_dag=False
+        )
+        if n_sweeps is not None or bool(ok):
+            break
+        if reverse_runner.small_allowed and reverse_runner.hint >= 32:
+            # same uint16-saturation fallback as SpfRunner.forward
+            reverse_runner.small_allowed = False
+        else:
+            reverse_runner.hint = sweeps * 2
+    bitmap = ecmp_bitmap_from_reverse_dist(
+        dist, out, edge_metric, edge_up, node_overloaded, out.n_words
+    )
+    return dist, bitmap, ok

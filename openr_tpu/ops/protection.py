@@ -38,7 +38,6 @@ from .sssp import (
 )
 
 
-@jax.jit
 def srlg_what_if(
     sources: jax.Array,  # [S] int32
     edge_src: jax.Array,  # [E]
@@ -48,13 +47,53 @@ def srlg_what_if(
     node_overloaded: jax.Array,  # [N] bool
     scenario_masks: jax.Array,  # [F, E] bool — True = edge SURVIVES
     ell=None,  # ops.sssp.EllGraph: run the production bucketed-ELL kernel
+    runner=None,  # ops.banded.SpfRunner: band-aware fixed-sweep execution
 ) -> jax.Array:
     """Distances under each failure scenario: [F, S, N] int32.
 
-    With `ell`, the (scenario x source) cross product flattens onto the
-    masked ELL kernel's single batch axis — the same formulation the
-    SRLG bench row runs, ~10x the edge-list fallback's throughput.
-    Distances only: the SP-DAG nobody reads here is never built."""
+    With `runner` (the production path), the (scenario x source) cross
+    product flattens onto the fixed-sweep band-aware kernel and the
+    result is host numpy.  With `ell`, the flattened batch runs the
+    while_loop masked-ELL kernel on device; the bare edge-list fallback
+    remains for tiny graphs.  Distances only: the SP-DAG nobody reads
+    here is never built."""
+    if runner is not None:
+        _check_runner_arrays(
+            runner, edge_src, edge_dst, edge_metric, edge_up, node_overloaded
+        )
+        f_dim = scenario_masks.shape[0]
+        s_dim = sources.shape[0]
+        flat_sources = jnp.tile(jnp.asarray(sources), f_dim)
+        flat_masks = jnp.repeat(
+            jnp.asarray(scenario_masks), s_dim, axis=0
+        )
+        dist, _ = runner.forward(
+            flat_sources, extra_edge_mask=flat_masks, want_dag=False
+        )
+        return dist.reshape(f_dim, s_dim, -1)
+    return _srlg_what_if_device(
+        sources,
+        edge_src,
+        edge_dst,
+        edge_metric,
+        edge_up,
+        node_overloaded,
+        scenario_masks,
+        ell,
+    )
+
+
+@jax.jit
+def _srlg_what_if_device(
+    sources,
+    edge_src,
+    edge_dst,
+    edge_metric,
+    edge_up,
+    node_overloaded,
+    scenario_masks,
+    ell=None,
+):
     n_nodes = node_overloaded.shape[0]
     if ell is not None:
         f_dim = scenario_masks.shape[0]
@@ -103,7 +142,6 @@ def srlg_reachability_loss(
     return now_unreachable.sum(axes), degraded.sum(axes)
 
 
-@functools.partial(jax.jit, static_argnames=("max_degree",))
 def ti_lfa_backups(
     source: jax.Array,  # scalar int32 — protected source node
     out_edge_ids: jax.Array,  # [D] int32 — source's out-edge ids (-1 pad)
@@ -115,7 +153,8 @@ def ti_lfa_backups(
     reverse_edge_ids: jax.Array,  # [E] int32 — id of each edge's reverse
     max_degree: int,
     ell=None,  # ops.sssp.EllGraph: run the production bucketed-ELL kernel
-) -> tuple[jax.Array, jax.Array]:
+    runner=None,  # ops.banded.SpfRunner: band-aware fixed-sweep execution
+):
     """Post-convergence SPF per protected out-edge.
 
     Returns (dist [D, N], dag [D, E]): row d = distances / SP-DAG with
@@ -123,7 +162,47 @@ def ti_lfa_backups(
     destination v on failure of edge d is any first hop of row d's DAG;
     TI-LFA P/Q spaces and repair-segment endpoints derive from these plus
     per-neighbor distance rows (computed by the same kernel batched over
-    sources)."""
+    sources).  With `runner` the masks run the band-aware fixed-sweep
+    kernel and numpy arrays come back; otherwise device arrays."""
+    if runner is not None:
+        import numpy as _np
+
+        _check_runner_arrays(
+            runner, edge_src, edge_dst, edge_metric, edge_up, node_overloaded
+        )
+        d_dim = int(out_edge_ids.shape[0])
+        survives = build_edge_failure_masks(
+            out_edge_ids, reverse_edge_ids, edge_src.shape[0]
+        )
+        sources = _np.full(d_dim, int(source), dtype=_np.int32)
+        return runner.forward(sources, extra_edge_mask=survives)
+    return _ti_lfa_backups_device(
+        source,
+        out_edge_ids,
+        edge_src,
+        edge_dst,
+        edge_metric,
+        edge_up,
+        node_overloaded,
+        reverse_edge_ids,
+        max_degree=max_degree,
+        ell=ell,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("max_degree",))
+def _ti_lfa_backups_device(
+    source,
+    out_edge_ids,
+    edge_src,
+    edge_dst,
+    edge_metric,
+    edge_up,
+    node_overloaded,
+    reverse_edge_ids,
+    max_degree: int,
+    ell=None,
+) -> tuple[jax.Array, jax.Array]:
     del max_degree  # shape already fixed by out_edge_ids
     n_edges = edge_src.shape[0]
     d_dim = out_edge_ids.shape[0]
@@ -159,6 +238,54 @@ def ti_lfa_backups(
     )
     dag = sp_dag_mask(dist, edge_src, edge_dst, edge_metric, allowed)
     return dist, dag
+
+
+def _check_runner_arrays(
+    runner, edge_src, edge_dst, edge_metric, edge_up, node_overloaded
+) -> None:
+    """The runner path answers from the arrays captured in the runner —
+    reject a call that passes DIFFERENT arrays (e.g. a modified edge_up
+    copy), which would otherwise be silently ignored."""
+    import numpy as _np
+
+    r_src, r_dst, r_metric, r_up, r_ov = runner.arrays
+    for mine, theirs, name in (
+        (edge_src, r_src, "edge_src"),
+        (edge_dst, r_dst, "edge_dst"),
+        (edge_metric, r_metric, "edge_metric"),
+        (edge_up, r_up, "edge_up"),
+        (node_overloaded, r_ov, "node_overloaded"),
+    ):
+        if _np.asarray(mine) is not _np.asarray(theirs) and not (
+            _np.shares_memory(_np.asarray(mine), _np.asarray(theirs))
+            or _np.array_equal(_np.asarray(mine), _np.asarray(theirs))
+        ):
+            raise ValueError(
+                f"runner path: {name} differs from the runner's captured "
+                "array; mutate the runner's arrays (or drop runner=) "
+                "instead of passing a modified copy"
+            )
+
+
+def build_edge_failure_masks(
+    out_edge_ids, reverse_edge_ids, edge_capacity: int
+):
+    """[D, E_cap] survives-mask for per-edge failure rows: row d excludes
+    out_edge_ids[d] and its reverse (-1 pads exclude nothing).  Shared by
+    ti_lfa_backups and the bench harness so the pad-guard semantics live
+    in exactly one place."""
+    import numpy as np
+
+    fail = np.asarray(out_edge_ids)
+    rev = np.asarray(reverse_edge_ids)
+    fail_rev = np.where(fail >= 0, rev[np.maximum(fail, 0)], -1)
+    edge_ids = np.arange(edge_capacity, dtype=np.int64)
+    # a -1 entry (pad) must exclude NO edge: compare against -2 sentinels
+    fail_cmp = np.where(fail >= 0, fail, -2)
+    rev_cmp = np.where(fail_rev >= 0, fail_rev, -2)
+    return (edge_ids[None, :] != fail_cmp[:, None]) & (
+        edge_ids[None, :] != rev_cmp[:, None]
+    )
 
 
 def build_reverse_edge_ids(edge_src, edge_dst) -> "jax.Array":

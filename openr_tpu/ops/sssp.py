@@ -736,6 +736,56 @@ def spf_forward_full_packed(
     return jnp.concatenate(parts)
 
 
+@functools.partial(
+    jax.jit, static_argnames=("use_link_metric", "n_sweeps", "want_dag")
+)
+def spf_forward_ell_sweeps(
+    sources: jax.Array,
+    ell: EllGraph,
+    edge_src: jax.Array,
+    edge_dst: jax.Array,
+    edge_metric: jax.Array,
+    edge_up: jax.Array,
+    node_overloaded: jax.Array,
+    n_sweeps: int,
+    use_link_metric: bool = True,
+    extra_edge_mask: Optional[jax.Array] = None,
+    want_dag: bool = True,
+):
+    """Fixed-sweep ELL forward: (dist [S, N_cap], dag, converged) — the
+    production execution discipline (no data-dependent while_loop, which
+    costs a host sync per iteration on latency-bound transports) exposed
+    for dist+dag callers: bench rows and batch KSP/what-if runs on
+    topologies without band structure (see ops.banded for the rest)."""
+    n_cap = node_overloaded.shape[0]
+    extra_T = None
+    if extra_edge_mask is not None:
+        extra_T = (
+            extra_edge_mask.T
+            if extra_edge_mask.ndim == 2
+            else extra_edge_mask[:, None]
+        )
+    allowed_T = make_relax_allowed_T(
+        sources, edge_src, edge_up, node_overloaded, extra_T
+    )
+    dist_T, converged = batched_sssp_ell(
+        make_dist0_T(sources, ell.new_of_old, n_cap),
+        ell,
+        row_allowed_T=allowed_T if extra_edge_mask is not None else None,
+        unit_metric=not use_link_metric,
+        edge_up=edge_up,
+        node_overloaded=node_overloaded,
+        edge_metric=edge_metric,
+        n_sweeps=n_sweeps,
+    )
+    dist_old_T = ell_dist_to_old_T(dist_T, ell)
+    if not want_dag:
+        return dist_old_T.T, None, converged
+    metric = edge_metric if use_link_metric else jnp.ones_like(edge_metric)
+    dag = sp_dag_mask_from_T(dist_old_T, edge_src, edge_dst, metric, allowed_T)
+    return dist_old_T.T, dag, converged
+
+
 @functools.partial(jax.jit, static_argnames=("use_link_metric",))
 def spf_forward(
     sources: jax.Array,  # [S] int32

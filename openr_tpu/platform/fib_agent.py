@@ -33,7 +33,7 @@ import time
 from typing import Any, Iterable, Optional
 
 from ..serializer import from_wire, to_wire
-from ..types import MplsRoute, NextHop, UnicastRoute
+from ..types import MplsAction, MplsActionCode, MplsRoute, NextHop, UnicastRoute
 
 log = logging.getLogger(__name__)
 
@@ -136,9 +136,13 @@ class KernelRouteTable:
     Unicast v4/v6 incl. multipath ride RTM_NEWROUTE/DELROUTE through the
     nl codec; per-client separation uses the kernel protocol id exactly
     like the reference (clientIdtoProtocolId).  MPLS label routes are
-    tracked in-process only (kernels in most deployments need the
-    mpls_router module + sysctl; the reference gates the same way) —
-    get_mpls_route_table_by_client stays truthful to what was requested.
+    programmed as AF_MPLS kernel routes (RTA_VIA + RTA_NEWDST) and READ
+    BACK from the kernel — get_mpls_route_table_by_client and
+    sync_mpls_fib diff against kernel truth, so they survive an agent
+    restart (reference: getMplsRouteTableByClient,
+    openr/platform/NetlinkFibHandler.cpp).  On kernels without AF_MPLS
+    support (mpls_router not loaded) the first programming attempt trips
+    a fallback to in-process tracking, logged once.
     """
 
     def __init__(self, table_id: Optional[int] = None) -> None:
@@ -148,7 +152,10 @@ class KernelRouteTable:
         self._alive_since = int(time.time() * 1000)
         self.nl = NetlinkProtocolSocket()
         self.table_id = RT_TABLE_MAIN if table_id is None else table_id
+        # in-process MPLS mirror: authoritative ONLY when the kernel
+        # lacks AF_MPLS (self._mpls_kernel is False)
         self.mpls: dict[int, dict[int, MplsRoute]] = {}
+        self._mpls_kernel: Optional[bool] = None  # None = not yet probed
         self.counters: dict[str, int] = {}
         self._if_index: dict[str, int] = {}
 
@@ -174,9 +181,42 @@ class KernelRouteTable:
                 l.if_name: l.if_index for l in self.nl.get_all_links()
             }
             # negative-cache misses: a vanished interface must not cost a
-            # full link dump per route
+            # full link dump per route.  The entry self-heals: any
+            # add_route failure drops negative entries so an interface
+            # that appears later is picked up (_drop_negative_ifcache).
             idx = self._if_index.setdefault(if_name, 0)
         return idx
+
+    def _drop_negative_ifcache(self, route: UnicastRoute) -> bool:
+        """Invalidate negative (index 0) cache entries named by `route`'s
+        nexthops; True when any was dropped (retry is worthwhile)."""
+        dropped = False
+        for nh in route.next_hops:
+            if nh.if_name and self._if_index.get(nh.if_name) == 0:
+                del self._if_index[nh.if_name]
+                dropped = True
+        return dropped
+
+    def _add_route(self, client_id: int, route: UnicastRoute) -> None:
+        """add_route with negative-ifindex self-healing: a failure whose
+        route referenced a negatively-cached interface re-dumps the link
+        map and retries once — a newly-appeared interface must not stay
+        invisible until an unrelated cache miss (advisor r3).  Only
+        errnos a missing OIF can cause trigger the retry (EINVAL: v6
+        link-local gateway without device; ENODEV): unrelated failures
+        must not pay a link dump + doomed resend per route."""
+        import errno as _errno
+
+        from ..nl.netlink import NetlinkError
+
+        try:
+            self.nl.add_route(self._to_route_info(client_id, route))
+        except NetlinkError as exc:
+            if exc.errno not in (_errno.EINVAL, _errno.ENODEV):
+                raise
+            if not self._drop_negative_ifcache(route):
+                raise
+            self.nl.add_route(self._to_route_info(client_id, route))
 
     def _to_route_info(self, client_id: int, route: UnicastRoute):
         from ..nl.netlink import NextHopInfo, RouteInfo
@@ -186,6 +226,14 @@ class KernelRouteTable:
                 gateway=nh.address or None,
                 if_index=self._ifindex(nh.if_name),
                 weight=max(nh.weight, 1),
+                # SR label PUSH rides the MPLS lwtunnel encap
+                push_labels=(
+                    tuple(nh.mpls_action.push_labels)
+                    if nh.mpls_action is not None
+                    and nh.mpls_action.action == MplsActionCode.PUSH
+                    and nh.mpls_action.push_labels
+                    else ()
+                ),
             )
             for nh in route.next_hops
         ]
@@ -203,7 +251,7 @@ class KernelRouteTable:
     ) -> None:
         with self._lock:
             for route in routes:
-                self.nl.add_route(self._to_route_info(client_id, route))
+                self._add_route(client_id, route)
             self._bump("fibagent.kernel.add_unicast", len(routes))
 
     def delete_unicast_routes(
@@ -228,18 +276,103 @@ class KernelRouteTable:
                         raise
             self._bump("fibagent.kernel.del_unicast", len(prefixes))
 
+    # -- MPLS: kernel AF_MPLS programming with readback -----------------------
+
+    def _to_mpls_route_info(self, client_id: int, route: MplsRoute):
+        from ..nl.netlink import MplsRouteInfo, NextHopInfo
+
+        nexthops = []
+        for nh in route.next_hops:
+            act = nh.mpls_action
+            swap: tuple = ()
+            gateway = nh.address or None
+            if act is not None:
+                # `is not None`: swap to label 0 (explicit null) is legal
+                # and must not degrade to a pop
+                if (
+                    act.action == MplsActionCode.SWAP
+                    and act.swap_label is not None
+                ):
+                    swap = (act.swap_label,)
+                elif act.action == MplsActionCode.PUSH and act.push_labels:
+                    swap = tuple(act.push_labels)
+                elif act.action == MplsActionCode.POP_AND_LOOKUP:
+                    gateway = None  # oif-only: kernel pops + looks up
+            nexthops.append(
+                NextHopInfo(
+                    gateway=gateway,
+                    if_index=self._ifindex(nh.if_name),
+                    weight=max(nh.weight, 1),
+                    swap_labels=swap,
+                )
+            )
+        return MplsRouteInfo(
+            label=route.top_label,
+            protocol=self._protocol(client_id),
+            nexthops=nexthops,
+        )
+
+    def _mpls_try_kernel(self, op) -> bool:
+        """Run an AF_MPLS netlink op; returns False (and latches the
+        in-process fallback) when the kernel has no MPLS support."""
+        import errno as _errno
+
+        from ..nl.netlink import NetlinkError
+
+        if self._mpls_kernel is False:
+            return False
+        try:
+            op()
+            self._mpls_kernel = True
+            return True
+        except NetlinkError as exc:
+            if self._mpls_kernel is None and exc.errno in (
+                _errno.EAFNOSUPPORT,
+                getattr(_errno, "EPFNOSUPPORT", _errno.EAFNOSUPPORT),
+                _errno.EPROTONOSUPPORT,
+                _errno.EOPNOTSUPP,
+            ):
+                log.warning(
+                    "kernel has no AF_MPLS support (%s); falling back to "
+                    "in-process MPLS route tracking",
+                    exc,
+                )
+                self._mpls_kernel = False
+                return False
+            raise
+
     def add_mpls_routes(self, client_id: int, routes: list[MplsRoute]) -> None:
         with self._lock:
-            table = self.mpls.setdefault(client_id, {})
             for route in routes:
-                table[route.top_label] = route
+                info = self._to_mpls_route_info(client_id, route)
+                if not self._mpls_try_kernel(
+                    lambda info=info: self.nl.add_mpls_route(info)
+                ):
+                    self.mpls.setdefault(client_id, {})[
+                        route.top_label
+                    ] = route
             self._bump("fibagent.kernel.add_mpls", len(routes))
 
     def delete_mpls_routes(self, client_id: int, labels: list[int]) -> None:
+        import errno as _errno
+
+        from ..nl.netlink import MplsRouteInfo, NetlinkError
+
         with self._lock:
-            table = self.mpls.setdefault(client_id, {})
             for label in labels:
-                table.pop(label, None)
+                info = MplsRouteInfo(
+                    label=label, protocol=self._protocol(client_id)
+                )
+                try:
+                    programmed = self._mpls_try_kernel(
+                        lambda info=info: self.nl.del_mpls_route(info)
+                    )
+                except NetlinkError as exc:
+                    if exc.errno != _errno.ESRCH:  # already gone
+                        raise
+                    programmed = True
+                if not programmed:
+                    self.mpls.setdefault(client_id, {}).pop(label, None)
             self._bump("fibagent.kernel.del_mpls", len(labels))
 
     def sync_fib(self, client_id: int, routes: list[UnicastRoute]) -> None:
@@ -261,24 +394,98 @@ class KernelRouteTable:
                     protocol=self._protocol(client_id), table=self.table_id
                 )
             }
-            for route in routes:
-                self.nl.add_route(self._to_route_info(client_id, route))
-            from ..nl.netlink import RouteInfo
+            # collect per-route failures and STILL run the stale-route
+            # deletion pass: one bad add must not leave this client's
+            # stale kernel routes behind until the next sync (advisor
+            # r3; mirrors the reference's keep/add/remove diff)
+            from ..nl.netlink import NetlinkError, RouteInfo
 
+            errors: list[str] = []
+            for route in routes:
+                try:
+                    self._add_route(client_id, route)
+                except NetlinkError as exc:
+                    errors.append(f"{route.dest}: {exc}")
             for dst in current - set(wanted):
-                self.nl.del_route(
-                    RouteInfo(
-                        dst=dst,
-                        table=self.table_id,
-                        protocol=self._protocol(client_id),
+                try:
+                    self.nl.del_route(
+                        RouteInfo(
+                            dst=dst,
+                            table=self.table_id,
+                            protocol=self._protocol(client_id),
+                        )
                     )
-                )
+                except NetlinkError as exc:
+                    errors.append(f"del {dst}: {exc}")
             self._bump("fibagent.kernel.sync_fib")
+            if errors:
+                raise RuntimeError(
+                    f"sync_fib: {len(errors)} route(s) failed: "
+                    + "; ".join(errors[:8])
+                )
 
     def sync_mpls_fib(self, client_id: int, routes: list[MplsRoute]) -> None:
+        """Full MPLS sync diffed against KERNEL readback (not in-process
+        state), so a restarted agent still withdraws stale label routes —
+        the round-3 gap this closes (reference: future_syncMplsFib,
+        openr/platform/NetlinkFibHandler.cpp)."""
+        from ..nl.netlink import MplsRouteInfo, NetlinkError
+
         with self._lock:
-            self.mpls[client_id] = {r.top_label: r for r in routes}
+            wanted = {r.top_label for r in routes}
+            proto = self._protocol(client_id)
+            current: set[int] = set()
+            dump_ok = False
+            if self._mpls_kernel is not False:
+                try:
+                    current = {
+                        r.label for r in self.nl.get_mpls_routes(proto)
+                    }
+                    # a successful dump does NOT prove AF_MPLS support
+                    # (the kernel answers dumps for unregistered families
+                    # with an empty set) — only a successful ADD latches
+                    # _mpls_kernel=True, via _mpls_try_kernel below
+                    dump_ok = True
+                except OSError:
+                    # transient dump failure (ENOBUFS, timeout) or
+                    # no-MPLS kernel; the adds below decide which
+                    pass
+            errors: list[str] = []
+            kernel_mode = True
+            for route in routes:
+                info = self._to_mpls_route_info(client_id, route)
+                try:
+                    if not self._mpls_try_kernel(
+                        lambda info=info: self.nl.add_mpls_route(info)
+                    ):
+                        kernel_mode = False
+                        break
+                except NetlinkError as exc:
+                    errors.append(f"label {route.top_label}: {exc}")
+            if not kernel_mode or self._mpls_kernel is False:
+                self.mpls[client_id] = {r.top_label: r for r in routes}
+            elif dump_ok:
+                for label in current - wanted:
+                    try:
+                        self.nl.del_mpls_route(
+                            MplsRouteInfo(label=label, protocol=proto)
+                        )
+                    except NetlinkError as exc:
+                        errors.append(f"del label {label}: {exc}")
+            else:
+                # stale-route withdrawal NEEDS the readback; skipping it
+                # silently would leave stale labels while reporting
+                # success — surface it so Fib's backoff retries the sync
+                errors.append(
+                    "kernel MPLS readback failed; stale-route deletion "
+                    "skipped"
+                )
             self._bump("fibagent.kernel.sync_mpls_fib")
+            if errors:
+                raise RuntimeError(
+                    f"sync_mpls_fib: {len(errors)} route(s) failed: "
+                    + "; ".join(errors[:8])
+                )
 
     def get_route_table_by_client(self, client_id: int) -> list[UnicastRoute]:
         with self._lock:
@@ -305,11 +512,68 @@ class KernelRouteTable:
             return sorted(out, key=lambda r: r.dest)
 
     def get_mpls_route_table_by_client(self, client_id: int) -> list[MplsRoute]:
+        """Kernel readback of this client's AF_MPLS routes, with nexthop
+        actions inferred from the wire form (RTA_NEWDST stack -> SWAP/
+        PUSH, bare via -> PHP, oif-only -> POP_AND_LOOKUP); in-process
+        table only on no-MPLS kernels.
+
+        Wire-fidelity caveat: a single-label PUSH and a SWAP are the SAME
+        kernel route (one-entry RTA_NEWDST), so readback reports SWAP for
+        both; programmed weight 0 reads back as 1 (rtnh_hops).  Consumers
+        must not full-equality-diff readback against intent —
+        sync_mpls_fib correctly diffs by label only."""
         with self._lock:
-            return sorted(
-                self.mpls.get(client_id, {}).values(),
-                key=lambda r: r.top_label,
-            )
+            if self._mpls_kernel is False:
+                return sorted(
+                    self.mpls.get(client_id, {}).values(),
+                    key=lambda r: r.top_label,
+                )
+            try:
+                kernel_routes = self.nl.get_mpls_routes(
+                    self._protocol(client_id)
+                )
+            except OSError:
+                if self._mpls_kernel is True:
+                    # kernel mode is established: a transient dump
+                    # failure must surface, not read back as an empty
+                    # table (the in-process dict is empty in this mode)
+                    raise
+                # unprobed kernel: may simply lack AF_MPLS
+                return sorted(
+                    self.mpls.get(client_id, {}).values(),
+                    key=lambda r: r.top_label,
+                )
+            index_name = {
+                l.if_index: l.if_name for l in self.nl.get_all_links()
+            }
+            out = []
+            for r in kernel_routes:
+                hops = []
+                for nh in r.nexthops:
+                    if nh.swap_labels and len(nh.swap_labels) == 1:
+                        act = MplsAction(
+                            MplsActionCode.SWAP,
+                            swap_label=nh.swap_labels[0],
+                        )
+                    elif nh.swap_labels:
+                        act = MplsAction(
+                            MplsActionCode.PUSH,
+                            push_labels=tuple(nh.swap_labels),
+                        )
+                    elif nh.gateway is not None:
+                        act = MplsAction(MplsActionCode.PHP)
+                    else:
+                        act = MplsAction(MplsActionCode.POP_AND_LOOKUP)
+                    hops.append(
+                        NextHop(
+                            address=nh.gateway or "",
+                            if_name=index_name.get(nh.if_index),
+                            weight=nh.weight,
+                            mpls_action=act,
+                        )
+                    )
+                out.append(MplsRoute(top_label=r.label, next_hops=hops))
+            return sorted(out, key=lambda r: r.top_label)
 
     def alive_since(self) -> int:
         return self._alive_since

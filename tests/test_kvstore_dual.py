@@ -378,6 +378,51 @@ class TestUnreliablePeerBounds:
         )
         assert dropped >= DUAL_SEND_BACKLOG_MAX
 
+    def test_overflow_to_live_peer_triggers_dual_reconcile(self, fabric):
+        """An outbox overflow against a peer that STAYS UP must schedule
+        a DUAL state bounce once the backlog drains (advisor r3:
+        reconnect-time reconciliation alone never fires for a
+        slow-but-alive peer).  The overflow marks the peer; the drainer
+        clears the flag and bounces peer_down/peer_up, whose regenerated
+        messages deliver over the now-healthy channel."""
+        from openr_tpu.kvstore.kvstore import DUAL_SEND_BACKLOG_MAX
+        from openr_tpu.types import DualMessages
+
+        fab, make = fabric
+        a = make("a", is_flood_root=True)
+        b = make("b", is_flood_root=False)
+        stores = [a, b]
+        full_mesh(stores)
+        assert wait_for(lambda: all_initialized(stores))
+        assert wait_for(lambda: spt_converged(stores, "a"))
+
+        def overflow_storm():
+            db = a._db("0")
+            peer = db.peers["b"]
+            # flood the outbox past the cap with empty (but well-formed)
+            # message batches; peer b is alive, so the drainer delivers
+            # and then reconciles
+            for _ in range(DUAL_SEND_BACKLOG_MAX + 8):
+                db._dual_to_peer(peer, DualMessages(src_id="a"))
+            return peer.dual_reconcile_needed
+
+        marked = a._call(overflow_storm)
+        assert marked, "overflow against a live peer must mark reconcile"
+
+        def reconciled():
+            counters = a.get_counters()
+            db_peer_flag = a._call(
+                lambda: a._db("0").peers["b"].dual_reconcile_needed
+            )
+            return (
+                counters.get("kvstore.dual.num_overflow_reconcile", 0) >= 1
+                and not db_peer_flag
+            )
+
+        assert wait_for(reconciled), "drainer never ran the DUAL bounce"
+        # the mesh must re-converge to a valid SPT after the bounce
+        assert wait_for(lambda: spt_converged(stores, "a"))
+
     def test_anti_entropy_sync_is_silent_in_steady_state(self, fabric):
         """Periodic anti-entropy reconciliation must not re-fire
         KvStoreSyncEvent (downstream initialization signaling) or the

@@ -373,3 +373,254 @@ class TestKernelNeighbors:
             assert mine[0].family == socket.AF_INET6
         finally:
             subprocess.run(["ip", "link", "del", name], capture_output=True)
+
+
+class TestMplsCodec:
+    """AF_MPLS route + label-stack encode -> parse round trips and MPLS
+    push encap on IP routes (no kernel needed).  Reference codec:
+    NetlinkRouteMessage MPLS build/parse, openr/nl/NetlinkRoute.h:41-176."""
+
+    def test_label_stack_roundtrip(self):
+        from openr_tpu.nl.netlink import pack_label_stack, unpack_label_stack
+
+        for stack in ((100,), (100, 200), (16, 17, 1048575)):
+            assert unpack_label_stack(pack_label_stack(stack)) == stack
+
+    def test_swap_route_roundtrip(self):
+        from openr_tpu.nl.netlink import (
+            MplsRouteInfo,
+            build_mpls_route_request,
+        )
+
+        r = MplsRouteInfo(
+            label=100,
+            nexthops=[
+                NextHopInfo(
+                    gateway="fe80::1", if_index=7, swap_labels=(200,)
+                )
+            ],
+        )
+        raw = build_mpls_route_request(RTM_NEWROUTE, 1, r)
+        back = next(parse_messages(raw)).mpls_route
+        assert back is not None
+        assert back.label == 100
+        assert back.protocol == RTPROT_OPENR
+        assert [(n.gateway, n.if_index, n.swap_labels) for n in back.nexthops] == [
+            ("fe80::1", 7, (200,))
+        ]
+
+    def test_multipath_mpls_roundtrip(self):
+        from openr_tpu.nl.netlink import (
+            MplsRouteInfo,
+            build_mpls_route_request,
+        )
+
+        r = MplsRouteInfo(
+            label=300,
+            nexthops=[
+                NextHopInfo(gateway="fe80::1", if_index=3, swap_labels=(301,)),
+                NextHopInfo(gateway="fe80::2", if_index=4),  # PHP: no stack
+            ],
+        )
+        raw = build_mpls_route_request(RTM_NEWROUTE, 2, r)
+        back = next(parse_messages(raw)).mpls_route
+        assert back.label == 300
+        assert [(n.gateway, n.swap_labels) for n in back.nexthops] == [
+            ("fe80::1", (301,)),
+            ("fe80::2", ()),
+        ]
+
+    def test_pop_route_is_oif_only(self):
+        from openr_tpu.nl.netlink import (
+            MplsRouteInfo,
+            build_mpls_route_request,
+        )
+
+        r = MplsRouteInfo(
+            label=400, nexthops=[NextHopInfo(if_index=1)]  # POP_AND_LOOKUP
+        )
+        back = next(
+            parse_messages(build_mpls_route_request(RTM_NEWROUTE, 3, r))
+        ).mpls_route
+        assert back.nexthops[0].gateway is None
+        assert back.nexthops[0].if_index == 1
+        assert back.nexthops[0].swap_labels == ()
+
+    def test_unicast_push_encap_roundtrip(self):
+        """Label PUSH on an IP route rides the MPLS lwtunnel encap
+        (reference: NetlinkRoute.cpp push path)."""
+        r = RouteInfo(
+            dst="2001:db8:9::/64",
+            nexthops=[
+                NextHopInfo(
+                    gateway="fe80::9", if_index=5, push_labels=(100, 200)
+                )
+            ],
+        )
+        back = next(
+            parse_messages(build_route_request(RTM_NEWROUTE, 4, r))
+        ).route
+        assert back.nexthops[0].push_labels == (100, 200)
+
+    def test_multipath_push_encap_roundtrip(self):
+        r = RouteInfo(
+            dst="2001:db8:a::/64",
+            nexthops=[
+                NextHopInfo(gateway="fe80::1", if_index=5, push_labels=(77,)),
+                NextHopInfo(gateway="fe80::2", if_index=6),
+            ],
+        )
+        back = next(
+            parse_messages(build_route_request(RTM_NEWROUTE, 5, r))
+        ).route
+        assert [n.push_labels for n in back.nexthops] == [(77,), ()]
+
+    def test_neigh_request_codec(self):
+        """Neighbor add/del requests round-trip through the parser
+        (reference: NetlinkNeighborMessage build, NetlinkRoute.h:255)."""
+        from openr_tpu.nl.netlink import RTM_NEWNEIGH, build_neigh_request
+
+        raw = build_neigh_request(
+            RTM_NEWNEIGH, 7, 3, "2001:db8::9", "02:00:00:00:00:02"
+        )
+        back = next(parse_messages(raw)).neigh
+        assert back is not None
+        assert (back.if_index, back.dst, back.lladdr) == (
+            3,
+            "2001:db8::9",
+            "02:00:00:00:00:02",
+        )
+        assert back.state == 0x80  # NUD_PERMANENT
+
+
+def _mpls_kernel_available() -> bool:
+    import os
+
+    return NET_ADMIN and os.path.isdir("/proc/sys/net/mpls")
+
+
+@pytest.mark.skipif(not NET_ADMIN, reason="needs NET_ADMIN (veth creation)")
+class TestKernelNeighborProgramming:
+    def test_neighbor_add_del_roundtrip(self):
+        """Program a kernel neighbor, read it back, delete it
+        (reference: NetlinkRoute.h:255 + NeighborBuilder,
+        NetlinkTypes.h:48-285) — the last codec surface delta (r3 #9)."""
+        name = f"np{uuid.uuid4().hex[:8]}"
+        subprocess.run(
+            ["ip", "link", "add", name, "type", "veth",
+             "peer", "name", f"{name}p"],
+            check=True,
+        )
+        try:
+            subprocess.run(["ip", "link", "set", name, "up"], check=True)
+            nl = NetlinkProtocolSocket()
+            idx = {l.if_name: l.if_index for l in nl.get_all_links()}[name]
+            nl.add_neighbor(idx, "2001:db8:fe::77", "02:00:00:00:00:03")
+
+            def mine():
+                return [
+                    n
+                    for n in nl.get_all_neighbors()
+                    if n.if_index == idx and n.dst == "2001:db8:fe::77"
+                ]
+
+            got = mine()
+            assert len(got) == 1
+            assert got[0].lladdr == "02:00:00:00:00:03"
+            nl.del_neighbor(idx, "2001:db8:fe::77")
+            assert mine() == []
+        finally:
+            subprocess.run(["ip", "link", "del", name], capture_output=True)
+
+
+@pytest.mark.skipif(
+    not _mpls_kernel_available(),
+    reason="needs NET_ADMIN + kernel AF_MPLS (mpls_router)",
+)
+class TestKernelMplsRoutes:
+    """Real-kernel MPLS programming + restart readback (r3 gap #1;
+    reference: NetlinkFibHandler getMplsRouteTableByClient / syncMplsFib,
+    openr/platform/NetlinkFibHandler.cpp)."""
+
+    @pytest.fixture
+    def veth(self):
+        name = f"mp{uuid.uuid4().hex[:8]}"
+        subprocess.run(
+            ["ip", "link", "add", name, "type", "veth",
+             "peer", "name", f"{name}p"],
+            check=True,
+        )
+        # platform_labels: rewriting it FLUSHES every MPLS route on the
+        # host, so only grow it when too small and restore the original
+        # afterwards (it starts at 0 on a fresh mpls_router load, so the
+        # restore is usually a no-op flush of our own deleted routes)
+        orig_labels = open("/proc/sys/net/mpls/platform_labels").read().strip()
+        try:
+            subprocess.run(["ip", "link", "set", name, "up"], check=True)
+            if int(orig_labels) < 1000:
+                subprocess.run(
+                    ["sysctl", "-w", "net.mpls.platform_labels=1000"],
+                    check=True,
+                )
+            subprocess.run(
+                ["sysctl", "-w", f"net.mpls.conf.{name}.input=1"], check=True
+            )
+            yield name
+        finally:
+            if int(orig_labels) < 1000:
+                subprocess.run(
+                    ["sysctl", "-w", f"net.mpls.platform_labels={orig_labels}"],
+                    capture_output=True,
+                )
+            subprocess.run(["ip", "link", "del", name], capture_output=True)
+
+    def test_mpls_restart_readback_and_sync(self, veth):
+        from openr_tpu.types import MplsAction, MplsActionCode, MplsRoute
+
+        table = KernelRouteTable()
+        try:
+            route = MplsRoute(
+                top_label=100,
+                next_hops=[
+                    NextHop(
+                        address="2001:db8:fe::2",
+                        if_name=veth,
+                        mpls_action=MplsAction(
+                            MplsActionCode.SWAP, swap_label=200
+                        ),
+                    )
+                ],
+            )
+            stale = MplsRoute(
+                top_label=101,
+                next_hops=[
+                    NextHop(
+                        address="2001:db8:fe::2",
+                        if_name=veth,
+                        mpls_action=MplsAction(MplsActionCode.PHP),
+                    )
+                ],
+            )
+            table.add_mpls_routes(786, [route, stale])
+            assert table._mpls_kernel is True
+
+            # agent RESTART: a fresh table must read routes back from the
+            # KERNEL, not from (lost) in-process state
+            table2 = KernelRouteTable()
+            try:
+                got = table2.get_mpls_route_table_by_client(786)
+                assert [r.top_label for r in got] == [100, 101]
+                swap = got[0].next_hops[0]
+                assert swap.mpls_action.action == MplsActionCode.SWAP
+                assert swap.mpls_action.swap_label == 200
+                assert swap.if_name == veth
+
+                # sync diffs against kernel truth: label 101 is stale
+                table2.sync_mpls_fib(786, [route])
+                left = table2.get_mpls_route_table_by_client(786)
+                assert [r.top_label for r in left] == [100]
+            finally:
+                table2.delete_mpls_routes(786, [100])
+                table2.nl.close_request_socket()
+        finally:
+            table.nl.close_request_socket()
