@@ -1,0 +1,157 @@
+"""Virtual-mesh scaling evidence for the sharded SPF steps.
+
+The multi-chip projections (source-axis sharding over a
+``("batch", "node")`` mesh) rest on a linearity assumption: the
+per-device executable does 1/B of the batch work with no hidden
+replication, and collectives appear only when the node axis is split.
+This harness VALIDATES that assumption with the strongest evidence a
+single-core host can produce:
+
+- **per-device compiled cost** (XLA ``compiled.cost_analysis()``): FLOPs
+  and bytes accessed of the per-device program at batch-axis sizes 1/2/
+  4/8 over the virtual CPU mesh.  Linear sharding means flops(B) ~
+  flops(1)/B; a replicated or resharded intermediate would show up
+  immediately as a flat term.
+- **single-core wall ratio**: on one physical core the B virtual devices
+  serialize, so wall(B-dev sharded, total S) / wall(1-dev, total S)
+  measures the sharding OVERHEAD factor (partition + runtime), which
+  multiplies any real-hardware projection.
+- **collective check**: the batch-only layout's only collectives are
+  the O(1)-byte scalar reductions of the convergence verdict
+  (jnp.any/jnp.all across the sharded batch); splitting the node axis
+  must introduce the real data collectives (all-gathers of the [N, S]
+  row-gather operands — the documented ICI cost).
+
+What this deliberately does NOT claim: real multi-chip wall-clock.  One
+core cannot time 8 devices; the artifact records the measured per-device
+cost division + overhead factor instead of asserting wall-time speedup
+(bench_details carries both numbers and this note).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def _collect(step, args, mesh_desc: str):
+    import jax
+
+    lowered = step.lower(*args)
+    compiled = lowered.compile()
+    cost = compiled.cost_analysis()
+    if isinstance(cost, list):  # per-device list on some backends
+        cost = cost[0]
+    flops = float(cost.get("flops", 0.0))
+    bytes_accessed = float(cost.get("bytes accessed", 0.0))
+    # collective detection from the optimized HLO text
+    hlo = compiled.as_text()
+    collectives = sum(
+        hlo.count(op)
+        for op in ("all-gather", "all-reduce", "collective-permute")
+    )
+    out = compiled(*args)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(compiled(*args))
+        times.append((time.perf_counter() - t0) * 1e3)
+    return {
+        "mesh": mesh_desc,
+        "flops_per_device": flops,
+        "bytes_per_device": bytes_accessed,
+        "collective_ops": collectives,
+        "wall_ms_min": round(min(times), 2),
+    }
+
+
+def run(n_side: int = 32, n_sources: int = 1024, n_variants: int = 256) -> dict:
+    import jax
+
+    # the axon plugin pre-imports jax at interpreter startup, so env-var
+    # platform selection may be ignored; pin CPU explicitly (the virtual
+    # 8-device mesh only exists there)
+    if jax.default_backend() != "cpu":
+        jax.config.update("jax_default_device", jax.devices("cpu")[0])
+    import jax.numpy as jnp
+    import numpy as np
+
+    from benchmarks import synthetic
+    from openr_tpu.parallel import mesh as pmesh
+
+    assert len(jax.devices("cpu")) >= 8, "needs the 8-device virtual mesh"
+    topo = synthetic.grid(n_side)
+    sources = jnp.arange(n_sources, dtype=jnp.int32) % topo.n_nodes
+    base_args = (
+        sources,
+        topo.ell,
+        jnp.asarray(topo.edge_src),
+        jnp.asarray(topo.edge_dst),
+        jnp.asarray(topo.edge_metric),
+        jnp.asarray(topo.edge_up),
+        jnp.asarray(topo.node_overloaded),
+    )
+
+    rows: dict = {"allsrc": [], "whatif": []}
+    for b in (1, 2, 4, 8):
+        mesh = pmesh.make_mesh(jax.devices("cpu")[:b], batch_axis=b)
+        step = pmesh.spf_step_sharded(mesh)
+        rows["allsrc"].append(_collect(step, base_args, f"batch={b}"))
+
+    # masked what-if fleet over the variant axis
+    rng = np.random.default_rng(3)
+    mask_t = np.ones((topo.edge_capacity, n_variants), dtype=bool)
+    fail = rng.integers(0, topo.n_edges, size=n_variants)
+    mask_t[fail, np.arange(n_variants)] = False
+    wa_args = (
+        jnp.zeros(n_variants, dtype=jnp.int32),
+        topo.ell,
+        jnp.asarray(topo.edge_src),
+        jnp.asarray(topo.edge_dst),
+        jnp.asarray(topo.edge_metric),
+        jnp.asarray(topo.edge_up),
+        jnp.asarray(topo.node_overloaded),
+        jnp.asarray(mask_t),
+    )
+    for b in (1, 8):
+        mesh = pmesh.make_mesh(jax.devices("cpu")[:b], batch_axis=b)
+        step = pmesh.whatif_step_sharded(mesh)
+        rows["whatif"].append(_collect(step, wa_args, f"batch={b}"))
+
+    # node-axis split: collectives must appear
+    mesh_node = pmesh.make_mesh(jax.devices("cpu")[:8], batch_axis=1)
+    step = pmesh.spf_step_sharded(mesh_node)
+    rows["node_axis"] = _collect(step, base_args, "batch=1,node=8")
+
+    f1 = rows["allsrc"][0]["flops_per_device"]
+    f8 = rows["allsrc"][3]["flops_per_device"]
+    w1 = rows["allsrc"][0]["wall_ms_min"]
+    w8 = rows["allsrc"][3]["wall_ms_min"]
+    return {
+        "topology": topo.name,
+        "n_sources": n_sources,
+        "n_variants": n_variants,
+        "rows": rows,
+        "flops_ratio_8dev": round(f8 / f1, 4) if f1 else None,
+        "ideal_flops_ratio": 0.125,
+        "singlecore_wall_overhead_8dev": (
+            round(w8 / w1, 3) if w1 else None
+        ),
+        "batch_layout_collectives": rows["allsrc"][3]["collective_ops"],
+        "node_layout_collectives": rows["node_axis"]["collective_ops"],
+        "note": (
+            "virtual 8-device CPU mesh on ONE physical core: wall-clock "
+            "speedup is unmeasurable here, so the linearity assumption "
+            "is validated structurally — per-device compiled FLOPs must "
+            "divide by the batch factor (flops_ratio_8dev ~ 0.125), the "
+            "batch layout's collectives must be only the O(1) "
+            "convergence-verdict scalar reductions, and the single-core "
+            "wall ratio bounds the sharding overhead factor that "
+            "multiplies any real-hardware projection"
+        ),
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(run()))

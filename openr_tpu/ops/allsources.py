@@ -181,8 +181,9 @@ def reduced_all_sources(
         )
         if n_sweeps is not None or bool(ok):
             break
-        if reverse_runner.small_allowed and reverse_runner.hint >= 32:
+        if reverse_runner.small_dist and reverse_runner.hint >= 32:
             # same uint16-saturation fallback as SpfRunner.forward
+            # (keyed on the effective mode of the failed run)
             reverse_runner.small_allowed = False
         else:
             reverse_runner.hint = sweeps * 2

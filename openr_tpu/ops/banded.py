@@ -403,6 +403,7 @@ def spf_forward_banded(
         row_allowed_T=row_allowed_T,
         small_dist=small_dist,
     )
+    dist16 = None
     if small_dist is True:
         # saturation guard: with every edge weight < WBIG16, any true
         # distance that would overflow INF16 forces SOME node into the
@@ -411,12 +412,27 @@ def spf_forward_banded(
         # >= WBIG16 — those edges would be masked as down here.)
         fin_max = jnp.max(jnp.where(dist < INF16, dist, jnp.uint16(0)))
         converged = converged & (fin_max < WBIG16)
+        dist16 = dist
         dist = jnp.where(dist >= INF16, INF32, dist.astype(jnp.int32))
     if not want_dag:
         return dist.T, None, converged
     allowed_T = make_relax_allowed_T(
         sources, edge_src, edge_up, node_overloaded, extra_T
     )
+    if dist16 is not None:
+        # DAG membership in the uint16 domain: the gathers move half the
+        # bytes (the dominant cost of the extraction at large S).  Valid
+        # because finite d + metric < 2^16 (both < WBIG16-bounded) and
+        # saturated entries are excluded by the d_u < INF16 guard.
+        m16 = jnp.minimum(metric, jnp.int32(WBIG16)).astype(jnp.uint16)
+        d_u = jnp.take(dist16, edge_src, axis=0)  # [E, S] uint16
+        d_v = jnp.take(dist16, edge_dst, axis=0)
+        dag_T = (
+            allowed_T
+            & (d_u < INF16)
+            & (d_u + m16[:, None] == d_v)
+        )
+        return dist.T, dag_T.T, converged
     dag = sp_dag_mask_from_T(dist, edge_src, edge_dst, metric, allowed_T)
     return dist.T, dag, converged
 
@@ -486,10 +502,14 @@ class SpfRunner:
         extra_edge_mask=None,
         want_dag: bool = True,
         n_sweeps: Optional[int] = None,
+        metric_plane=None,
     ):
         """(dist np [S, N*], dag np|None).  With `n_sweeps`, runs exactly
         one fixed-sweep call (caller owns the hint — bench timing);
-        otherwise doubles the learned hint until converged."""
+        otherwise doubles the learned hint until converged.
+        `metric_plane` substitutes an alternate [E_cap] metric array
+        (e.g. a TE cost plane) for this call — same graph, different
+        costs, no table rebuild (BASELINE config #3 dual-metric KSP)."""
         import numpy as _np
 
         sources = jnp.asarray(_np.asarray(sources, dtype=_np.int32))
@@ -501,6 +521,7 @@ class SpfRunner:
                 use_link_metric=use_link_metric,
                 extra_edge_mask=extra_edge_mask,
                 want_dag=want_dag,
+                metric_plane=metric_plane,
             )
             if bool(ok):
                 break
@@ -508,10 +529,12 @@ class SpfRunner:
                 raise RuntimeError(
                     f"fixed {sweeps}-sweep run did not converge"
                 )
-            if self.small_allowed and self.hint >= 32:
+            if self.small_dist and self.hint >= 32:
                 # saturation guard can also fail convergence; after two
                 # doublings under uint16, retry in int32 before doubling
-                # further
+                # further.  Keyed on the EFFECTIVE uint16 mode of the
+                # failed run — an int32 run must double instead of
+                # repeating the identical dispatch.
                 self.small_allowed = False
             else:
                 self.hint = sweeps * 2
@@ -527,11 +550,18 @@ class SpfRunner:
         use_link_metric: bool = True,
         extra_edge_mask=None,
         want_dag: bool = True,
+        metric_plane=None,
     ):
         """One fixed-sweep device call; returns jax (dist, dag, ok)."""
         from .sssp import spf_forward_ell_sweeps
 
         edge_src, edge_dst, edge_metric, edge_up, node_overloaded = self.arrays
+        if metric_plane is not None:
+            edge_metric = metric_plane
+        # gate uint16 on the EFFECTIVE metric plane for this call
+        small = self.small_allowed and pick_small_dist(
+            edge_metric, self.n_edges
+        )
         if self.bg is not None:
             return spf_forward_banded(
                 sources,
@@ -549,7 +579,7 @@ class SpfRunner:
                     if extra_edge_mask is None
                     else jnp.asarray(extra_edge_mask)
                 ),
-                small_dist=self.small_dist,
+                small_dist=small,
                 use_link_metric=use_link_metric,
                 want_dag=want_dag,
             )

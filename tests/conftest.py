@@ -18,13 +18,18 @@ if "xla_force_host_platform_device_count" not in xla_flags:
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The env vars alone are NOT enough in this environment: the TPU-tunnel
+# plugin pre-imports jax at interpreter startup and force-updates
+# jax_platforms to "axon,cpu", so JAX_PLATFORMS set here is read too
+# late.  Re-assert cpu at the config layer AND pin the default device —
+# either alone can leave uncommitted computations landing on the shared
+# (sometimes wedged) tunnel.  XLA_FLAGS still applies because the CPU
+# client initializes lazily on first use.
 import jax  # noqa: E402
 
-# The TPU-tunnel sitecustomize registers its backend at interpreter start
-# and force-updates jax_platforms to "axon,cpu", overriding the env var —
-# so backends() would still dial the (shared, sometimes unavailable)
-# tunnel.  Re-assert cpu at the config layer too.
 jax.config.update("jax_platforms", "cpu")
+if jax.default_backend() != "cpu":
+    jax.config.update("jax_default_device", jax.devices("cpu")[0])
 
 import pytest  # noqa: E402
 

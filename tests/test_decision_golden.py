@@ -1,0 +1,751 @@
+"""Golden route-level conformance cases ported from the reference's
+DecisionTest corpus (openr/decision/tests/DecisionTest.cpp, 6,888 LoC),
+round-4 batch: the interactions r3 flagged as uncovered — ordered-FIB
+holds x route build, BGP MetricVector x KSP2, multi-area redistribution,
+prepend labels, min-nexthop x drain, parallel links, duplicate labels.
+
+Every case runs against BOTH backends (host Dijkstra and the device
+kernel) and asserts identical RouteDatabases before checking the golden
+expectations; each test names its DecisionTest.cpp ancestor.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from openr_tpu.decision.link_state import LinkState
+from openr_tpu.decision.prefix_state import PrefixState
+from openr_tpu.decision.spf_solver import DeviceSpfBackend, SpfSolver
+from openr_tpu.types import (
+    Adjacency,
+    AdjacencyDatabase,
+    MetricEntity,
+    MetricVector,
+    MplsAction,
+    MplsActionCode,
+    MplsRoute,
+    NextHop,
+    PrefixEntry,
+    PrefixForwardingAlgorithm,
+    PrefixForwardingType,
+    PrefixMetrics,
+    PrefixType,
+    UnicastRoute,
+)
+from tests.test_spf_solver import (
+    PFX,
+    adj,
+    build_link_state,
+    prefix_state_with,
+    square,
+)
+
+
+def routes(my_node: str, area_ls: dict, ps: PrefixState, **solver_kw):
+    """Build the route DB on BOTH backends and assert parity; returns the
+    host result (the golden assertions read it)."""
+    host = SpfSolver(my_node, **solver_kw).build_route_db(area_ls, ps)
+    device = SpfSolver(
+        my_node,
+        spf_backend=DeviceSpfBackend(min_device_nodes=1),
+        **solver_kw,
+    ).build_route_db(area_ls, ps)
+    if host is None or device is None:
+        # unknown node: both backends must agree on nullopt
+        assert host is None and device is None, my_node
+        return None
+    assert host.unicast_routes == device.unicast_routes, my_node
+    assert host.mpls_routes == device.mpls_routes, my_node
+    return host
+
+
+def nh_names(route) -> set:
+    return {nh.neighbor_node_name for nh in route.nexthops}
+
+
+def sq_ksp(advertiser: str = "1", **entry_kw) -> PrefixState:
+    return prefix_state_with(
+        (
+            advertiser,
+            "0",
+            PrefixEntry(
+                prefix=PFX,
+                forwarding_type=PrefixForwardingType.SR_MPLS,
+                forwarding_algorithm=PrefixForwardingAlgorithm.KSP2_ED_ECMP,
+                **entry_kw,
+            ),
+        )
+    )
+
+
+class TestShortestPathEdgeCases:
+    """Ancestors: ShortestPathTest.* (DecisionTest.cpp:471-597)."""
+
+    def test_unreachable_nodes(self):
+        # DecisionTest.cpp:471 UnreachableNodes: two disconnected pairs
+        ls = build_link_state(
+            {
+                "1": [adj("1", "2")],
+                "2": [adj("2", "1")],
+                "3": [adj("3", "4")],
+                "4": [adj("4", "3")],
+            },
+            labels={"1": 101, "2": 102, "3": 103, "4": 104},
+        )
+        ps = prefix_state_with(
+            ("2", "0", PrefixEntry(prefix=PFX)),
+            ("4", "0", PrefixEntry(prefix="::2:0/112")),
+        )
+        db = routes("1", {"0": ls}, ps)
+        assert PFX in db.unicast_routes
+        assert "::2:0/112" not in db.unicast_routes  # other component
+        # label routes exist only for the reachable component
+        assert 102 in db.mpls_routes
+        assert 103 not in db.mpls_routes and 104 not in db.mpls_routes
+
+    def test_missing_neighbor_adjacency_db(self):
+        # DecisionTest.cpp:511: 1 claims adj to 2, but 2 never reported —
+        # the bidirectional-link check keeps the link out of SPF
+        ls = build_link_state({"1": [adj("1", "2")]})
+        ps = prefix_state_with(("2", "0", PrefixEntry(prefix=PFX)))
+        db = routes("1", {"0": ls}, ps)
+        assert PFX not in db.unicast_routes
+
+    def test_empty_neighbor_adjacency_db(self):
+        # DecisionTest.cpp:543: 2 reports an EMPTY adjacency list
+        ls = build_link_state({"1": [adj("1", "2")], "2": []})
+        ps = prefix_state_with(("2", "0", PrefixEntry(prefix=PFX)))
+        db = routes("1", {"0": ls}, ps)
+        assert PFX not in db.unicast_routes
+
+    def test_unknown_node(self):
+        # DecisionTest.cpp:579: solver for a node absent from the graph
+        # returns nullopt (no route DB at all), on both backends
+        ls = square()
+        ps = prefix_state_with(("4", "0", PrefixEntry(prefix=PFX)))
+        assert routes("99", {"0": ls}, ps) is None
+
+    def test_adjacency_metric_update_reroutes(self):
+        # DecisionTest.cpp:598 AdjacencyUpdate: one direction's metric
+        # change moves traffic (asymmetric metrics are per-direction)
+        adj_map = {
+            "1": [adj("1", "2"), adj("1", "3")],
+            "2": [adj("2", "1"), adj("2", "4")],
+            "3": [adj("3", "1"), adj("3", "4")],
+            "4": [adj("4", "2"), adj("4", "3")],
+        }
+        ls = build_link_state(adj_map)
+        ps = prefix_state_with(("4", "0", PrefixEntry(prefix=PFX)))
+        db = routes("1", {"0": ls}, ps)
+        assert nh_names(db.unicast_routes[PFX]) == {"2", "3"}
+        # raise metric of 1->2: path via 3 only
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="1",
+                adjacencies=[adj("1", "2", metric=50), adj("1", "3")],
+                area="0",
+            )
+        )
+        db = routes("1", {"0": ls}, ps)
+        assert nh_names(db.unicast_routes[PFX]) == {"3"}
+
+
+class TestParallelAdjacencies:
+    """Ancestors: ParallelAdjRingTopologyFixture.ShortestPathTest /
+    MultiPathTest (DecisionTest.cpp:3413, 3547), DecisionTestFixture.
+    ParallelLinks (:5917)."""
+
+    @staticmethod
+    def parallel_ls(m1: int = 10, m2: int = 10) -> LinkState:
+        a = Adjacency(
+            other_node_name="2",
+            if_name="1/2-a",
+            other_if_name="2/1-a",
+            metric=m1,
+            next_hop_v6="fe80::2a",
+        )
+        b = Adjacency(
+            other_node_name="2",
+            if_name="1/2-b",
+            other_if_name="2/1-b",
+            metric=m2,
+            next_hop_v6="fe80::2b",
+        )
+        ra = Adjacency(
+            other_node_name="1",
+            if_name="2/1-a",
+            other_if_name="1/2-a",
+            metric=m1,
+            next_hop_v6="fe80::1a",
+        )
+        rb = Adjacency(
+            other_node_name="1",
+            if_name="2/1-b",
+            other_if_name="1/2-b",
+            metric=m2,
+            next_hop_v6="fe80::1b",
+        )
+        ls = LinkState("0")
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="1", adjacencies=[a, b], area="0",
+                node_label=101,
+            )
+        )
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="2", adjacencies=[ra, rb], area="0",
+                node_label=102,
+            )
+        )
+        return ls
+
+    def test_equal_parallel_links_both_used(self):
+        ls = self.parallel_ls(10, 10)
+        ps = prefix_state_with(("2", "0", PrefixEntry(prefix=PFX)))
+        db = routes("1", {"0": ls}, ps)
+        route = db.unicast_routes[PFX]
+        assert {nh.if_name for nh in route.nexthops} == {"1/2-a", "1/2-b"}
+
+    def test_unequal_parallel_links_best_only(self):
+        ls = self.parallel_ls(10, 20)
+        ps = prefix_state_with(("2", "0", PrefixEntry(prefix=PFX)))
+        db = routes("1", {"0": ls}, ps)
+        route = db.unicast_routes[PFX]
+        assert {nh.if_name for nh in route.nexthops} == {"1/2-a"}
+
+    def test_parallel_link_flap_reroutes(self):
+        # ParallelLinks (:5917): losing the cheap link falls over to the
+        # remaining one
+        ls = self.parallel_ls(10, 20)
+        ps = prefix_state_with(("2", "0", PrefixEntry(prefix=PFX)))
+        routes("1", {"0": ls}, ps)
+        # re-advertise node 1 with only the expensive link
+        b = Adjacency(
+            other_node_name="2",
+            if_name="1/2-b",
+            other_if_name="2/1-b",
+            metric=20,
+            next_hop_v6="fe80::2b",
+        )
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="1", adjacencies=[b], area="0", node_label=101
+            )
+        )
+        db = routes("1", {"0": ls}, ps)
+        route = db.unicast_routes[PFX]
+        assert {nh.if_name for nh in route.nexthops} == {"1/2-b"}
+        assert all(nh.metric == 20 for nh in route.nexthops)
+
+
+class TestDuplicateNodeLabels:
+    """Ancestor: SimpleRingTopologyFixture.DuplicateMplsRoutes
+    (DecisionTest.cpp:2037)."""
+
+    def test_duplicate_label_programs_single_route(self):
+        ls = build_link_state(
+            {
+                "1": [adj("1", "2"), adj("1", "3")],
+                "2": [adj("2", "1"), adj("2", "4")],
+                "3": [adj("3", "1"), adj("3", "4")],
+                "4": [adj("4", "2"), adj("4", "3")],
+            },
+            labels={"1": 102, "2": 102, "3": 103, "4": 104},  # 1 == 2!
+        )
+        db = routes("3", {"0": ls}, PrefixState())
+        # exactly ONE route for label 102 (not two conflicting ones)
+        assert 102 in db.mpls_routes
+        assert 103 in db.mpls_routes and 104 in db.mpls_routes
+
+    def test_duplicate_resolved_after_relabel(self):
+        ls = build_link_state(
+            {
+                "1": [adj("1", "2"), adj("1", "3")],
+                "2": [adj("2", "1"), adj("2", "4")],
+                "3": [adj("3", "1"), adj("3", "4")],
+                "4": [adj("4", "2"), adj("4", "3")],
+            },
+            labels={"1": 102, "2": 102, "3": 103, "4": 104},
+        )
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="1",
+                adjacencies=[adj("1", "2"), adj("1", "3")],
+                node_label=101,
+                area="0",
+            )
+        )
+        db = routes("3", {"0": ls}, PrefixState())
+        assert 101 in db.mpls_routes and 102 in db.mpls_routes
+
+
+class TestOverloadInteractions:
+    """Ancestors: SimpleRingTopologyFixture.OverloadNodeTest (:2974),
+    OverloadLinkTest (:3093), x min-nexthop (IpToMplsLabelPrepend case 2,
+    :2296)."""
+
+    def test_overload_node_no_transit_golden(self):
+        # ring 1-2, 1-3, 2-4, 3-4 with 2 and 3 overloaded: from 2, node 3
+        # is reachable only via the long way 2->1->... no: 2-1-3 transits
+        # 1 (ok). From 2 to 3: direct paths 2-4-3 and 2-1-3 — both transit
+        # a non-overloaded node: ECMP of both (OverloadNodeTest golden)
+        ls = build_link_state(
+            {
+                "1": [adj("1", "2"), adj("1", "3")],
+                "2": [adj("2", "1"), adj("2", "4")],
+                "3": [adj("3", "1"), adj("3", "4")],
+                "4": [adj("4", "2"), adj("4", "3")],
+            },
+            labels={"1": 101, "2": 102, "3": 103, "4": 104},
+            overloaded={"2", "3"},
+        )
+        ps = prefix_state_with(("3", "0", PrefixEntry(prefix=PFX)))
+        db = routes("2", {"0": ls}, ps)
+        assert nh_names(db.unicast_routes[PFX]) == {"1", "4"}
+        # label route to 3 mirrors the ECMP with SWAPs
+        r3 = db.mpls_routes[103]
+        assert nh_names(r3) == {"1", "4"}
+        for nh in r3.nexthops:
+            assert nh.mpls_action == MplsAction(
+                MplsActionCode.SWAP, swap_label=103
+            )
+
+    def test_overload_link_disconnects(self):
+        # OverloadLinkTest (:3093): overloading BOTH of node 3's links
+        # leaves it unreachable from 1
+        a31 = adj("3", "1")
+        a31.is_overloaded = True
+        a34 = adj("3", "4")
+        a34.is_overloaded = True
+        ls = build_link_state(
+            {
+                "1": [adj("1", "2"), adj("1", "3")],
+                "2": [adj("2", "1"), adj("2", "4")],
+                "3": [a31, a34],
+                "4": [adj("4", "2"), adj("4", "3")],
+            },
+            labels={"1": 101, "2": 102, "3": 103, "4": 104},
+        )
+        ps = prefix_state_with(("3", "0", PrefixEntry(prefix=PFX)))
+        db = routes("1", {"0": ls}, ps)
+        assert PFX not in db.unicast_routes
+        assert 103 not in db.mpls_routes
+
+    def test_overload_link_one_side_reroutes(self):
+        # overloading 3's link to 1 (only) forces 1->3 via 2-4
+        a31 = adj("3", "1")
+        a31.is_overloaded = True
+        ls = build_link_state(
+            {
+                "1": [adj("1", "2"), adj("1", "3")],
+                "2": [adj("2", "1"), adj("2", "4")],
+                "3": [a31, adj("3", "4")],
+                "4": [adj("4", "2"), adj("4", "3")],
+            },
+        )
+        ps = prefix_state_with(("3", "0", PrefixEntry(prefix=PFX)))
+        db = routes("1", {"0": ls}, ps)
+        assert nh_names(db.unicast_routes[PFX]) == {"2"}
+
+    def test_min_nexthop_with_drained_transit(self):
+        # min-nexthop x drain (r3 gap): draining 2 removes one ECMP arm;
+        # a min_nexthop=2 prefix at 4 must then be withdrawn from 1
+        ls = build_link_state(
+            {
+                "1": [adj("1", "2"), adj("1", "3")],
+                "2": [adj("2", "1"), adj("2", "4")],
+                "3": [adj("3", "1"), adj("3", "4")],
+                "4": [adj("4", "2"), adj("4", "3")],
+            },
+            overloaded={"2"},
+        )
+        ps = prefix_state_with(
+            ("4", "0", PrefixEntry(prefix=PFX, min_nexthop=2))
+        )
+        db = routes("1", {"0": ls}, ps)
+        assert PFX not in db.unicast_routes
+        # with min_nexthop=1 the surviving arm programs
+        ps = prefix_state_with(
+            ("4", "0", PrefixEntry(prefix=PFX, min_nexthop=1))
+        )
+        db = routes("1", {"0": ls}, ps)
+        assert nh_names(db.unicast_routes[PFX]) == {"3"}
+
+
+class TestPrependLabels:
+    """Ancestor: SimpleRingTopologyFixture.IpToMplsLabelPrepend
+    (DecisionTest.cpp:2228)."""
+
+    PREPEND = 60001
+
+    def test_prepend_label_added_to_push_stack(self):
+        # case-3 (:2316): remote advertiser with prepend label — PUSH
+        # stack becomes [prepend, node-label]
+        ls = square()
+        ps = prefix_state_with(
+            (
+                "4",
+                "0",
+                PrefixEntry(
+                    prefix=PFX,
+                    forwarding_type=PrefixForwardingType.SR_MPLS,
+                    prepend_label=self.PREPEND,
+                ),
+            )
+        )
+        db = routes("1", {"0": ls}, ps)
+        route = db.unicast_routes[PFX]
+        assert nh_names(route) == {"2", "3"}
+        for nh in route.nexthops:
+            assert nh.mpls_action == MplsAction(
+                MplsActionCode.PUSH,
+                push_labels=(self.PREPEND, 104),
+            )
+
+    def test_prepend_label_to_neighbor_pushes_prepend_only(self):
+        # neighbor advertiser: no node label to push, prepend alone rides
+        ls = square()
+        ps = prefix_state_with(
+            (
+                "2",
+                "0",
+                PrefixEntry(
+                    prefix=PFX,
+                    forwarding_type=PrefixForwardingType.SR_MPLS,
+                    prepend_label=self.PREPEND,
+                ),
+            )
+        )
+        db = routes("1", {"0": ls}, ps)
+        route = db.unicast_routes[PFX]
+        assert nh_names(route) == {"2"}
+        for nh in route.nexthops:
+            assert nh.mpls_action == MplsAction(
+                MplsActionCode.PUSH, push_labels=(self.PREPEND,)
+            )
+
+    def test_invalid_prepend_label_empties_nexthops(self):
+        # :2343 isMplsLabelValid guard — an out-of-range prepend label
+        # skips every nexthop; the reference's addBestPaths still emits
+        # the (empty) RibUnicastEntry (Decision.cpp:1090-1150 has no
+        # empty-set early-out), so parity means: route present, no hops
+        ls = square()
+        ps = prefix_state_with(
+            (
+                "4",
+                "0",
+                PrefixEntry(
+                    prefix=PFX,
+                    forwarding_type=PrefixForwardingType.SR_MPLS,
+                    prepend_label=(1 << 20) + 7,  # > 20-bit label space
+                ),
+            )
+        )
+        db = routes("1", {"0": ls}, ps)
+        assert db.unicast_routes[PFX].nexthops == frozenset()
+
+    def test_self_prepend_label_with_static_nexthops(self):
+        # case-4 (:2337-2397): the advertiser itself reports the prefix
+        # with a prepend label + static MPLS nexthops for that label; its
+        # own route carries the remote PUSH arms plus the static hops
+        ls = square()
+        entry = PrefixEntry(
+            prefix=PFX,
+            forwarding_type=PrefixForwardingType.SR_MPLS,
+            prepend_label=self.PREPEND,
+        )
+        ps = prefix_state_with(("1", "0", entry), ("4", "0", entry))
+        static_hops = [
+            NextHop(address="1.1.1.1", mpls_action=MplsAction(MplsActionCode.PHP)),
+            NextHop(address="2.2.2.2", mpls_action=MplsAction(MplsActionCode.PHP)),
+        ]
+
+        def with_static(solver):
+            solver.update_static_mpls_routes(
+                [MplsRoute(top_label=self.PREPEND, next_hops=static_hops)], []
+            )
+            return solver.build_route_db({"0": ls}, ps)
+
+        host = with_static(SpfSolver("1"))
+        device = with_static(
+            SpfSolver("1", spf_backend=DeviceSpfBackend(min_device_nodes=1))
+        )
+        assert host.unicast_routes == device.unicast_routes
+        route = host.unicast_routes[PFX]
+        addrs = {nh.address for nh in route.nexthops}
+        # static next-hops surface (PUSH action stripped, :2365 NOTE)
+        assert {"1.1.1.1", "2.2.2.2"} <= addrs
+        static_in_route = [
+            nh for nh in route.nexthops if nh.address in ("1.1.1.1", "2.2.2.2")
+        ]
+        assert all(nh.mpls_action is None for nh in static_in_route)
+        # remote arms toward 4 push [prepend, label4]
+        remote = [nh for nh in route.nexthops if nh.neighbor_node_name]
+        assert remote and all(
+            nh.mpls_action
+            == MplsAction(
+                MplsActionCode.PUSH, push_labels=(self.PREPEND, 104)
+            )
+            for nh in remote
+        )
+
+
+def mv(value: int, priority: int = 1, tie_breaker: bool = False) -> MetricVector:
+    return MetricVector(
+        metrics=[
+            MetricEntity(
+                type=1,
+                priority=priority,
+                is_best_path_tie_breaker=tie_breaker,
+                metric=[value],
+            )
+        ]
+    )
+
+
+class TestBgpMetricVectorKsp2:
+    """Ancestors: SimpleRingTopologyFixture.Ksp2EdEcmpForBGP (:2602),
+    Ksp2EdEcmpForBGP123 (:2798), BGPRedistribution.IgpMetric (:973)."""
+
+    @staticmethod
+    def bgp_entry(value: int, **kw) -> PrefixEntry:
+        return PrefixEntry(
+            prefix=PFX,
+            type=PrefixType.BGP,
+            mv=mv(value),
+            forwarding_type=PrefixForwardingType.SR_MPLS,
+            forwarding_algorithm=PrefixForwardingAlgorithm.KSP2_ED_ECMP,
+            **kw,
+        )
+
+    def test_bgp_winner_gets_ksp2_paths(self):
+        # :2602 — the higher metric-vector advertiser wins BGP selection,
+        # and KSP2 computes two edge-disjoint label paths to IT
+        ls = square()
+        ps = prefix_state_with(
+            ("2", "0", self.bgp_entry(100)),
+            ("4", "0", self.bgp_entry(200)),  # winner
+        )
+        db = routes("1", {"0": ls}, ps)
+        route = db.unicast_routes[PFX]
+        assert route.best_prefix_entry.mv == mv(200)
+        # both edge-disjoint paths lead to 4: direct arms via 2 and 3
+        assert nh_names(route) == {"2", "3"}
+
+    def test_bgp_plain_tie_skips_route(self):
+        # :893-897 — equal vectors with NO tie-breaker entity is a plain
+        # TIE: the reference logs and skips the route entirely
+        ls = square()
+        ps = prefix_state_with(
+            ("2", "0", self.bgp_entry(200)),
+            ("3", "0", self.bgp_entry(200)),
+        )
+        db = routes("1", {"0": ls}, ps)
+        assert PFX not in db.unicast_routes
+
+    def test_bgp_tie_breaker_keeps_both_advertisers(self):
+        # TIE_WINNER/TIE_LOOSER accumulate: a tie-breaker entity orders
+        # the best entry but keeps BOTH advertisers in allNodeAreas
+        # (:881-887), so the ECMP merges their paths
+        ls = square()
+        e2 = PrefixEntry(
+            prefix=PFX,
+            type=PrefixType.BGP,
+            mv=mv(2, tie_breaker=True),
+            forwarding_type=PrefixForwardingType.SR_MPLS,
+            forwarding_algorithm=PrefixForwardingAlgorithm.KSP2_ED_ECMP,
+        )
+        e3 = PrefixEntry(
+            prefix=PFX,
+            type=PrefixType.BGP,
+            mv=mv(1, tie_breaker=True),
+            forwarding_type=PrefixForwardingType.SR_MPLS,
+            forwarding_algorithm=PrefixForwardingAlgorithm.KSP2_ED_ECMP,
+        )
+        ps = prefix_state_with(("2", "0", e2), ("3", "0", e3))
+        db = routes("1", {"0": ls}, ps)
+        route = db.unicast_routes[PFX]
+        # tie-winner is the best entry; both advertisers' paths merge
+        assert route.best_prefix_entry.mv == mv(2, tie_breaker=True)
+        assert nh_names(route) >= {"2", "3"}
+
+    def test_bgp_loser_flip_reroutes(self):
+        # flip the winner: routes must follow the new best advertiser
+        ls = square()
+        ps = prefix_state_with(
+            ("2", "0", self.bgp_entry(300)),
+            ("4", "0", self.bgp_entry(200)),
+        )
+        db = routes("1", {"0": ls}, ps)
+        route = db.unicast_routes[PFX]
+        # winner is the neighbor 2: first path direct; second
+        # edge-disjoint path around the ring
+        assert "2" in nh_names(route)
+        assert route.best_prefix_entry.mv == mv(300)
+
+    def test_bgp_ksp2_min_nexthop_interaction(self):
+        # KSP2 winner with min_nexthop above the path count: withdrawn
+        ls = square()
+        ps = prefix_state_with(
+            ("4", "0", self.bgp_entry(200, min_nexthop=3)),
+        )
+        db = routes("1", {"0": ls}, ps)
+        assert PFX not in db.unicast_routes
+
+
+class TestMultiAreaRedistribution:
+    """Ancestor: DecisionTestFixture.MultiAreaBestPathCalculation
+    (DecisionTest.cpp:5420) + SelfReditributePrefixPublication (:5563)."""
+
+    @staticmethod
+    def two_areas() -> dict:
+        # area 0: 1 -- 2 ;  area 1: 1 -- 3   (node 1 spans both)
+        ls0 = build_link_state(
+            {"1": [adj("1", "2")], "2": [adj("2", "1")]}, area="0"
+        )
+        ls1 = build_link_state(
+            {"1": [adj("1", "3")], "3": [adj("3", "1")]}, area="1"
+        )
+        return {"0": ls0, "1": ls1}
+
+    def test_cross_area_best_path(self):
+        # the same prefix advertised in both areas: area-local advertiser
+        # wins on distance at node 2's solver (10 vs 20 via 1)
+        areas = self.two_areas()
+        ps = prefix_state_with(
+            ("2", "0", PrefixEntry(prefix=PFX)),
+            ("3", "1", PrefixEntry(prefix=PFX)),
+        )
+        db = routes("1", areas, ps)
+        route = db.unicast_routes[PFX]
+        # node 1 sees both at distance 10: ECMP across areas
+        assert nh_names(route) == {"2", "3"}
+        areas_used = {nh.area for nh in route.nexthops}
+        assert areas_used == {"0", "1"}
+
+    def test_single_area_advertiser_reached_cross_area(self):
+        areas = self.two_areas()
+        ps = prefix_state_with(("3", "1", PrefixEntry(prefix=PFX)))
+        db = routes("1", areas, ps)
+        route = db.unicast_routes[PFX]
+        assert nh_names(route) == {"3"}
+        assert {nh.area for nh in route.nexthops} == {"1"}
+
+    def test_redistributed_self_entry_not_looped(self):
+        # SelfReditributePrefixPublication (:5563): a prefix this node
+        # re-advertised into another area must not produce a self route
+        areas = self.two_areas()
+        ps = prefix_state_with(
+            ("3", "1", PrefixEntry(prefix=PFX)),
+            # node 1's own redistribution of the same prefix into area 0
+            ("1", "0", PrefixEntry(prefix=PFX)),
+        )
+        db = routes("1", areas, ps)
+        # node 1 is among the best advertisers -> no route programmed on 1
+        # (reference: createRouteForPrefix skips self-advertised best)
+        assert PFX not in db.unicast_routes
+        # ...but node 2 in area 0 reaches it via 1
+        db2 = routes("2", areas, ps)
+        assert PFX in db2.unicast_routes
+        assert nh_names(db2.unicast_routes[PFX]) == {"1"}
+
+
+class TestOrderedFibHolds:
+    """Ancestor: the ordered-FIB hold machinery (HoldableValue,
+    LinkState.cpp decrementHolds + DecisionTest hold coverage): route
+    builds during the hold window must see the HELD topology, and the
+    hold decrement must atomically reveal the new one."""
+
+    def test_metric_hold_defers_reroute_until_decrement(self):
+        adj_map = {
+            "1": [adj("1", "2"), adj("1", "3")],
+            "2": [adj("2", "1"), adj("2", "4")],
+            "3": [adj("3", "1"), adj("3", "4")],
+            "4": [adj("4", "2"), adj("4", "3")],
+        }
+        ls = build_link_state(adj_map)
+        ps = prefix_state_with(("4", "0", PrefixEntry(prefix=PFX)))
+        db = routes("1", {"0": ls}, ps)
+        assert nh_names(db.unicast_routes[PFX]) == {"2", "3"}
+
+        # metric bump arrives WITH a hold (ordered-FIB): the route build
+        # must still use the old metric until holds decrement
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="1",
+                adjacencies=[adj("1", "2", metric=50), adj("1", "3")],
+                area="0",
+            ),
+            hold_up_ttl=2,
+            hold_down_ttl=2,
+        )
+        db = routes("1", {"0": ls}, ps)
+        assert nh_names(db.unicast_routes[PFX]) == {"2", "3"}  # held
+        assert ls.has_holds()
+
+        # decrement to expiry: the new metric takes effect
+        while ls.has_holds():
+            ls.decrement_holds()
+        db = routes("1", {"0": ls}, ps)
+        assert nh_names(db.unicast_routes[PFX]) == {"3"}
+
+    def test_overload_hold_defers_drain(self):
+        ls = build_link_state(
+            {
+                "1": [adj("1", "2"), adj("1", "3")],
+                "2": [adj("2", "1"), adj("2", "4")],
+                "3": [adj("3", "1"), adj("3", "4")],
+                "4": [adj("4", "2"), adj("4", "3")],
+            }
+        )
+        ps = prefix_state_with(("4", "0", PrefixEntry(prefix=PFX)))
+        # drain node 2 under a hold: traffic keeps flowing through it
+        # until the hold decrements (make-before-break)
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="2",
+                adjacencies=[adj("2", "1"), adj("2", "4")],
+                is_overloaded=True,
+                area="0",
+            ),
+            hold_up_ttl=1,
+            hold_down_ttl=1,
+        )
+        db = routes("1", {"0": ls}, ps)
+        assert nh_names(db.unicast_routes[PFX]) == {"2", "3"}
+        while ls.has_holds():
+            ls.decrement_holds()
+        db = routes("1", {"0": ls}, ps)
+        assert nh_names(db.unicast_routes[PFX]) == {"3"}
+
+    def test_ksp2_during_hold_window(self):
+        # holds x KSP2 (r3 gap): the masked KSP2 re-run must ALSO see the
+        # held topology, not the pending one
+        ls = square()
+        ps = sq_ksp("4")
+        db = routes("1", {"0": ls}, ps)
+        base_hops = nh_names(db.unicast_routes[PFX])
+        assert base_hops == {"2", "3"}
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="1",
+                adjacencies=[adj("1", "2", metric=100), adj("1", "3")],
+                node_label=101,
+                area="0",
+            ),
+            hold_up_ttl=2,
+            hold_down_ttl=2,
+        )
+        db = routes("1", {"0": ls}, ps)
+        assert nh_names(db.unicast_routes[PFX]) == {"2", "3"}  # held
+        while ls.has_holds():
+            ls.decrement_holds()
+        db = routes("1", {"0": ls}, ps)
+        # after the hold, the 1->2 arm costs 100: KSP first path rides 3,
+        # second edge-disjoint path still uses 2 (disjointness wins over
+        # cost — reference Ksp2EdEcmp longer-second-path semantics)
+        route = db.unicast_routes[PFX]
+        assert nh_names(route) == {"2", "3"}
+        by_nh = {nh.neighbor_node_name: nh.metric for nh in route.nexthops}
+        assert by_nh["3"] < by_nh["2"]
