@@ -300,11 +300,7 @@ def bench_allsrc_full_wan100k(topo, n_prefixes: int = 1024) -> dict:
     for i, v in enumerate(sample_v):
         np.testing.assert_array_equal(dist_np[:, v], cdist[i, dests])
 
-    times = []
-    for i in range(6):
-        if i == 3:
-            time.sleep(45)  # window split — see _time_device
-        t0 = time.perf_counter()
+    def run_reduced():
         dist, bitmap, ok = asrc.reduced_all_sources(
             dests,
             runner,
@@ -315,9 +311,43 @@ def bench_allsrc_full_wan100k(topo, n_prefixes: int = 1024) -> dict:
             n_sweeps=hint,
         )
         jax.block_until_ready((dist, bitmap))
-        times.append((time.perf_counter() - t0) * 1e3)
-    assert bool(ok)
+        return ok
+
+    times = _time_device(run_reduced, reps=6, warmup=0)
+    assert bool(run_reduced())
     end_to_end_ms = min(times)
+
+    # gap attribution (r3 next #2): where does the distance to the 50 ms
+    # target go?  A true zero-work dispatch doesn't exist (even
+    # n_supersweeps=1 runs one relax + the verification sweep), so
+    # derive per-sweep cost from the (1, hint) pair and attribute:
+    #   per_sweep     = (t(hint) - t(1)) / (hint - 1)
+    #   dispatch tax  = t(1) - 2*per_sweep   (1 relax + 1 verify sweep)
+    #   relax total   = (hint + 1) * per_sweep
+    #   bitmap pass   = bitmap-call wall minus the tax estimate
+    import jax.numpy as jnp
+
+    def _min_t(fn):
+        return min(_time_device(fn, reps=3, warmup=1, window_split_s=0))
+
+    metric_d = jnp.asarray(topo.edge_metric)
+    up_d = jnp.asarray(topo.edge_up)
+    ov_d = jnp.asarray(topo.node_overloaded)
+    t_one = _min_t(lambda: runner.run_once(dests, 1, want_dag=False))
+    t_kernel = _min_t(
+        lambda: runner.run_once(dests, hint, want_dag=False)
+    )
+    per_sweep = max(t_kernel - t_one, 0.0) / max(hint - 1, 1)
+    t_tax = max(t_one - 2 * per_sweep, 0.0)
+    dist_k, _, _ = runner.run_once(dests, hint, want_dag=False)
+    t_bitmap = (
+        _min_t(
+            lambda: asrc.ecmp_bitmap_from_reverse_dist(
+                dist_k, out, metric_d, up_d, ov_d, out.n_words
+            )
+        )
+        - t_tax
+    )
     return {
         "topology": topo.name,
         "n_nodes": n,
@@ -325,6 +355,13 @@ def bench_allsrc_full_wan100k(topo, n_prefixes: int = 1024) -> dict:
         "nh_bitmap_words": out.n_words,
         "end_to_end_ms": round(end_to_end_ms, 1),
         "end_to_end_ms_all": [round(t, 1) for t in times],
+        "gap_attribution_ms": {
+            "dispatch_tax_est": round(t_tax, 1),
+            "relax_sweeps_total": round(per_sweep * (hint + 1), 1),
+            "nh_bitmap_pass_marginal": round(max(t_bitmap, 0), 1),
+            "per_supersweep": round(per_sweep, 2),
+            "n_supersweeps": hint,
+        },
         "north_star_target_ms": 50.0,
         "note": (
             "reduced-output formulation (round-4): P-source reverse SSSP "
@@ -860,7 +897,7 @@ def bench_reconvergence(
 
     host = SpfSolver(own_node)
     device = SpfSolver(
-        own_node, spf_backend=DeviceSpfBackend(min_device_nodes=64)
+        own_node, spf_backend=DeviceSpfBackend(min_device_nodes=64, min_device_sources=1)
     )
     # warm both (compile device kernels, prime caches) + assert parity
     rdb_h = run(host)
@@ -895,6 +932,12 @@ def bench_reconvergence(
         "device_ms_p95": round(_pctl(device_times, 95), 3),
         "device_ms_all": [round(t, 2) for t in device_times],
         "device_vs_host": round(min(host_times) / min(device_times), 2),
+        "note": (
+            "measures the FORCED device path (min_device_sources=1); the "
+            "shipped default policy routes these small-batch flows to the "
+            "host below the measured batch crossover "
+            "(DeviceSpfBackend docstring)"
+        ),
     }
 
 
@@ -985,7 +1028,7 @@ def bench_ksp2(
 
     host_times, host_rdb = ms(None, host_reps)
     device_times, device_rdb = ms(
-        DeviceSpfBackend(min_device_nodes=64), device_reps
+        DeviceSpfBackend(min_device_nodes=64, min_device_sources=1), device_reps
     )
     assert host_rdb.unicast_routes == device_rdb.unicast_routes
     return {
@@ -996,6 +1039,12 @@ def bench_ksp2(
         "device_ms_min": round(min(device_times), 3),
         "device_ms_all": [round(t, 2) for t in device_times],
         "device_vs_host": round(min(host_times) / min(device_times), 2),
+        "note": (
+            "measures the FORCED device path (min_device_sources=1); the "
+            "shipped default policy routes these small-batch flows to the "
+            "host below the measured batch crossover "
+            "(DeviceSpfBackend docstring)"
+        ),
     }
 
 

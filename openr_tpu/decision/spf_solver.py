@@ -129,11 +129,37 @@ class DeviceSpfBackend:
     first hops); batch consumers (what-if, KSP, ctrl any-node queries)
     go through `prefetch` to amortize one call over many sources.
 
-    Below `min_device_nodes` the host Dijkstra memo is served instead —
-    kernel dispatch overhead beats graph work on tiny topologies."""
+    Dispatch policy (defaulted from round-4 measurement, bench_details
+    reconverge/ksp2 + srlg/allsrc rows): through a latency-bound
+    transport the wall-clock discriminator is BATCH SIZE, not node
+    count — batched questions (what-if fleets, all-sources tiles, KSP
+    destination sets; S >= ~256) win on the device at every measured
+    scale, while single-question flows (S <= ~9) lose to the host
+    Dijkstra even at 10k nodes (device_vs_host 0.47 at fattree10k) and
+    only the amortized per-question cost wins (16x at wan100k).  So:
 
-    def __init__(self, min_device_nodes: int = 64) -> None:
+    - below `min_device_nodes` (tiny topologies): always host.
+    - batches of >= `min_device_sources` (default 32 — the measured
+      per-question host cost at 10k is ~70 ms while a forced device
+      flow costs ~750 ms wall, putting the crossover near S~11; 32
+      sits safely above it without cliffing mid-size batches onto S
+      sequential host Dijkstras): device.
+    - smaller batches: host, unless the topology is at/above
+      `force_device_nodes` — a bound the measurements did NOT reach
+      (host still won wall at 100k for S=9 through the tunnel), kept as
+      an escape hatch for untunneled deployments where the per-dispatch
+      fee is ~0.04 ms and the device wins everywhere above tiny.
+    """
+
+    def __init__(
+        self,
+        min_device_nodes: int = 64,
+        min_device_sources: int = 32,
+        force_device_nodes: int = 131072,
+    ) -> None:
         self.min_device_nodes = min_device_nodes
+        self.min_device_sources = min_device_sources
+        self.force_device_nodes = force_device_nodes
         # Keyed on the LinkState object itself (weakly) rather than id():
         # ids are recycled after GC, so an id-keyed cache could serve
         # another topology's results and leaks entries for dead
@@ -201,8 +227,19 @@ class DeviceSpfBackend:
             self._results[link_state] = cached
         return cached[1]
 
+    def _device_worthwhile(self, link_state: LinkState, n_sources: int) -> bool:
+        """The measured dispatch policy (class docstring)."""
+        n = link_state.num_nodes()
+        if n < self.min_device_nodes:
+            return False
+        return (
+            n_sources >= self.min_device_sources
+            or n >= self.force_device_nodes
+        )
+
     def prefetch(self, link_state: LinkState, sources: list[str]) -> None:
-        """Compute many sources in one device call and cache them."""
+        """Compute many sources in one device call and cache them (host
+        memo below the measured batch crossover)."""
         if link_state.num_nodes() < self.min_device_nodes:
             return
         cache = self._result_cache(link_state)
@@ -211,10 +248,17 @@ class DeviceSpfBackend:
             for s in sources
             if s not in cache and link_state.links_from_node(s)
         ]
-        if missing:
-            csr = self._mirror(link_state)
-            cache.update(csr.spf_from(missing))
-            self._harvest_hint(csr)
+        if not missing:
+            return
+        if not self._device_worthwhile(link_state, len(missing)):
+            # small batch: the host memo answers ahead of wall-losing
+            # small dispatches; results land in the same cache
+            for s in missing:
+                cache[s] = link_state.get_spf_result(s)
+            return
+        csr = self._mirror(link_state)
+        cache.update(csr.spf_from(missing))
+        self._harvest_hint(csr)
 
     def prefetch_via_mesh(
         self, link_state: LinkState, sources: list[str], mesh
@@ -286,6 +330,12 @@ class DeviceSpfBackend:
         if not link_state.links_from_node(src):
             # isolated/unknown node: empty-but-self result via host path
             return link_state.get_spf_result(src)
+        if not self._device_worthwhile(link_state, 1):
+            # single-question miss below the measured crossover: host
+            # memo (a batch prefetch would have populated the cache)
+            res = link_state.get_spf_result(src)
+            cache[src] = res
+            return res
         csr = self._mirror(link_state)
         cache.update(csr.spf_from([src]))
         self._harvest_hint(csr)
@@ -308,7 +358,10 @@ class DeviceSpfBackend:
         cache = self._kth_cache(link_state)
         hit = cache.get((src, dest, k))
         if hit is not None:
-            return hit
+            return hit  # a batch prefetch populated it
+        if not self._device_worthwhile(link_state, 1):
+            # single-question miss below the measured batch crossover
+            return link_state.get_kth_paths(src, dest, k)
         # single miss: batch of one (the solver prefetches the full
         # destination set ahead of per-prefix queries)
         self.prefetch_kth_paths(link_state, src, [dest])
@@ -332,8 +385,8 @@ class DeviceSpfBackend:
         dest-d's first-path links down."""
         from .link_state import trace_one_path
 
-        if link_state.num_nodes() < self.min_device_nodes:
-            return
+        if not self._device_worthwhile(link_state, len(dests)):
+            return  # host recursion serves the per-prefix queries
         csr = self._mirror(link_state)
         if src not in csr.node_id:
             return  # unknown/linkless source: host fallback serves it

@@ -513,8 +513,15 @@ class SpfRunner:
         import numpy as _np
 
         sources = jnp.asarray(_np.asarray(sources, dtype=_np.int32))
+        doubled_from: Optional[int] = None
         while True:
             sweeps = n_sweeps if n_sweeps is not None else self.hint
+            # the EFFECTIVE uint16 mode of this run — gated on the
+            # metric plane actually used, exactly as run_once gates it
+            eff_small = self.small_allowed and pick_small_dist(
+                metric_plane if metric_plane is not None else self.arrays[2],
+                self.n_edges,
+            )
             dist, dag, ok = self.run_once(
                 sources,
                 sweeps,
@@ -524,19 +531,49 @@ class SpfRunner:
                 metric_plane=metric_plane,
             )
             if bool(ok):
+                if doubled_from is not None and n_sweeps is None:
+                    # refine DOWN: doubling overshoots by up to 2x, and
+                    # every production dispatch pays the surplus sweeps
+                    # forever.  A short binary search between the failed
+                    # and the successful count lands the minimal hint
+                    # (one-time probe dispatches; results discarded).
+                    # capped at 2 probes: each distinct sweep count is a
+                    # fresh XLA compile (~tens of seconds at 100k), so
+                    # land within ~12% of minimal and stop
+                    lo, hi = doubled_from, sweeps
+                    probes = 0
+                    while hi - lo > 1 and probes < 2:
+                        probes += 1
+                        mid = (lo + hi) // 2
+                        _, _, mid_ok = self.run_once(
+                            sources,
+                            mid,
+                            use_link_metric=use_link_metric,
+                            extra_edge_mask=extra_edge_mask,
+                            want_dag=False,
+                            metric_plane=metric_plane,
+                        )
+                        if bool(mid_ok):
+                            hi = mid
+                        else:
+                            lo = mid
+                    self.hint = hi
                 break
             if n_sweeps is not None:
                 raise RuntimeError(
                     f"fixed {sweeps}-sweep run did not converge"
                 )
-            if self.small_dist and self.hint >= 32:
+            if eff_small and self.hint >= 32:
                 # saturation guard can also fail convergence; after two
                 # doublings under uint16, retry in int32 before doubling
-                # further.  Keyed on the EFFECTIVE uint16 mode of the
-                # failed run — an int32 run must double instead of
-                # repeating the identical dispatch.
+                # further.  Keyed on the failed run's effective mode —
+                # an int32 run must double instead of repeating the
+                # identical dispatch, and a uint16 metric-plane run must
+                # be able to take this branch even when the base plane
+                # is int32-gated.
                 self.small_allowed = False
             else:
+                doubled_from = sweeps
                 self.hint = sweeps * 2
         return (
             _np.asarray(dist),

@@ -133,7 +133,7 @@ class TestCsrRefresh:
 class TestDeviceSpfBackendV2:
     def test_lazy_and_cached(self):
         ls = build(random_topology(24, 30, seed=1))
-        be = DeviceSpfBackend(min_device_nodes=1)
+        be = DeviceSpfBackend(min_device_nodes=1, min_device_sources=1)
         r1 = be.get_spf_result(ls, "n0")
         assert be._results[ls][1].keys() == {"n0"}  # only the asked source
         r2 = be.get_spf_result(ls, "n0")
@@ -146,7 +146,7 @@ class TestDeviceSpfBackendV2:
     def test_cache_invalidated_on_version_bump(self):
         dbs = _square()
         ls = build(dbs)
-        be = DeviceSpfBackend(min_device_nodes=1)
+        be = DeviceSpfBackend(min_device_nodes=1, min_device_sources=1)
         r1 = be.get_spf_result(ls, "a")
         assert r1["d"].metric == 2
         dbs[0].adjacencies[0].metric = 9  # a->b
@@ -159,7 +159,7 @@ class TestDeviceSpfBackendV2:
 
     def test_prefetch_batches(self):
         ls = build(random_topology(30, 40, seed=4))
-        be = DeviceSpfBackend(min_device_nodes=1)
+        be = DeviceSpfBackend(min_device_nodes=1, min_device_sources=1)
         be.prefetch(ls, ls.node_names)
         cache = be._results[ls][1]
         assert set(cache.keys()) == set(ls.node_names)
@@ -171,7 +171,7 @@ class TestDeviceSpfBackendV2:
 
     def test_small_topology_uses_host(self):
         ls = build(_square())
-        be = DeviceSpfBackend(min_device_nodes=64)
+        be = DeviceSpfBackend(min_device_nodes=64, min_device_sources=1)
         r = be.get_spf_result(ls, "a")
         assert r["d"].metric == 2
         assert ls not in be._mirrors  # device path never touched

@@ -47,7 +47,7 @@ def routes(my_node: str, area_ls: dict, ps: PrefixState, **solver_kw):
     host = SpfSolver(my_node, **solver_kw).build_route_db(area_ls, ps)
     device = SpfSolver(
         my_node,
-        spf_backend=DeviceSpfBackend(min_device_nodes=1),
+        spf_backend=DeviceSpfBackend(min_device_nodes=1, min_device_sources=1),
         **solver_kw,
     ).build_route_db(area_ls, ps)
     if host is None or device is None:
@@ -469,7 +469,7 @@ class TestPrependLabels:
 
         host = with_static(SpfSolver("1"))
         device = with_static(
-            SpfSolver("1", spf_backend=DeviceSpfBackend(min_device_nodes=1))
+            SpfSolver("1", spf_backend=DeviceSpfBackend(min_device_nodes=1, min_device_sources=1))
         )
         assert host.unicast_routes == device.unicast_routes
         route = host.unicast_routes[PFX]
@@ -649,6 +649,72 @@ class TestMultiAreaRedistribution:
         db2 = routes("2", areas, ps)
         assert PFX in db2.unicast_routes
         assert nh_names(db2.unicast_routes[PFX]) == {"1"}
+
+
+class TestBestRouteSelectionChain:
+    """Ancestors: Decision.BestRouteSelection (DecisionTest.cpp:1139),
+    EnableBestRouteSelectionFixture.PrefixWithMixedTypeRoutes (:6719),
+    DecisionTestFixture.DuplicatePrefixes (:6267)."""
+
+    def test_metrics_chain_flips(self):
+        # path_preference dominates, then source_preference, then
+        # distance — flip each level and watch the winner move
+        ls = square()
+
+        def entry(pp, sp):
+            return PrefixEntry(
+                prefix=PFX,
+                metrics=PrefixMetrics(
+                    path_preference=pp, source_preference=sp
+                ),
+            )
+
+        ps = prefix_state_with(
+            ("2", "0", entry(2000, 100)), ("3", "0", entry(1000, 900))
+        )
+        db = routes(
+            "1", {"0": ls}, ps, enable_best_route_selection=True
+        )
+        assert nh_names(db.unicast_routes[PFX]) == {"2"}  # pp wins
+        ps = prefix_state_with(
+            ("2", "0", entry(2000, 100)), ("3", "0", entry(2000, 900))
+        )
+        db = routes(
+            "1", {"0": ls}, ps, enable_best_route_selection=True
+        )
+        assert nh_names(db.unicast_routes[PFX]) == {"3"}  # sp breaks tie
+
+    def test_mixed_bgp_nonbgp_requires_best_route_selection(self):
+        # :6719 — a prefix advertised BGP by one node and RIB by another
+        # is rejected without best-route selection and resolved with it
+        ls = square()
+        ps = prefix_state_with(
+            ("2", "0", PrefixEntry(prefix=PFX, type=PrefixType.BGP, mv=mv(1))),
+            ("3", "0", PrefixEntry(prefix=PFX, type=PrefixType.RIB)),
+        )
+        db = routes("1", {"0": ls}, ps)
+        assert PFX not in db.unicast_routes  # mixed types rejected
+        db = routes(
+            "1", {"0": ls}, ps, enable_best_route_selection=True
+        )
+        assert PFX in db.unicast_routes  # selection resolves the mix
+
+    def test_duplicate_prefix_withdrawal_keeps_other_advertiser(self):
+        # DuplicatePrefixes (:6267): two advertisers, one withdraws —
+        # the route survives via the other
+        ls = square()
+        ps = prefix_state_with(
+            ("2", "0", PrefixEntry(prefix=PFX)),
+            ("4", "0", PrefixEntry(prefix=PFX)),
+        )
+        db = routes("1", {"0": ls}, ps)
+        assert "2" in nh_names(db.unicast_routes[PFX])
+        ps.delete_prefix("2", "0", PFX)
+        db = routes("1", {"0": ls}, ps)
+        route = db.unicast_routes[PFX]
+        # advertiser 4 remains: ECMP via both neighbors at distance 20
+        assert nh_names(route) == {"2", "3"}
+        assert all(nh.metric == 20 for nh in route.nexthops)
 
 
 class TestOrderedFibHolds:

@@ -40,7 +40,7 @@ class TestKthPathsConformance:
         dbs = random_topology(n_nodes=80, n_extra_edges=120, seed=seed)
         ls_host = build_ls(dbs)
         ls_dev = build_ls(dbs)
-        backend = DeviceSpfBackend(min_device_nodes=1)
+        backend = DeviceSpfBackend(min_device_nodes=1, min_device_sources=1)
 
         nodes = sorted(ls_host.node_names)
         src = nodes[0]
@@ -56,7 +56,7 @@ class TestKthPathsConformance:
         dbs = grid_topology(6)
         ls_host = build_ls(dbs)
         ls_dev = build_ls(dbs)
-        backend = DeviceSpfBackend(min_device_nodes=1)
+        backend = DeviceSpfBackend(min_device_nodes=1, min_device_sources=1)
         src = "node-0-0"
         dests = ["node-5-5", "node-0-5", "node-3-2", "node-1-0"]
         for dest in dests:
@@ -68,14 +68,14 @@ class TestKthPathsConformance:
     def test_src_equals_dest_and_unknown(self):
         dbs = grid_topology(4)
         ls = build_ls(dbs)
-        backend = DeviceSpfBackend(min_device_nodes=1)
+        backend = DeviceSpfBackend(min_device_nodes=1, min_device_sources=1)
         assert backend.get_kth_paths(ls, "node-0-0", "node-0-0", 1) == []
         assert backend.get_kth_paths(ls, "node-0-0", "node-0-0", 2) == []
 
     def test_cache_invalidated_on_topology_change(self):
         dbs = grid_topology(4)
         ls = build_ls(dbs)
-        backend = DeviceSpfBackend(min_device_nodes=1)
+        backend = DeviceSpfBackend(min_device_nodes=1, min_device_sources=1)
         before = backend.get_kth_paths(ls, "node-0-0", "node-3-3", 1)
         assert before
         # fail a link on the first path: results must change
@@ -114,6 +114,6 @@ class TestKsp2RouteParity:
         algo_nodes = ["node-4-4", "node-2-3"]
         host_rdb = self._route_db(None, grid_topology(5), algo_nodes)
         dev_rdb = self._route_db(
-            DeviceSpfBackend(min_device_nodes=1), grid_topology(5), algo_nodes
+            DeviceSpfBackend(min_device_nodes=1, min_device_sources=1), grid_topology(5), algo_nodes
         )
         assert host_rdb.unicast_routes == dev_rdb.unicast_routes

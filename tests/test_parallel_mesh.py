@@ -234,7 +234,7 @@ class TestMeshThroughSolver:
             )
 
         mesh = make_mesh(eight_cpu_devices)
-        backend = DeviceSpfBackend(min_device_nodes=64)
+        backend = DeviceSpfBackend(min_device_nodes=64, min_device_sources=1)
         # prefetch EVERY node's SPF through the sharded mesh step
         backend.prefetch_via_mesh(ls, nodes, mesh)
 

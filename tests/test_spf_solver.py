@@ -516,7 +516,7 @@ class TestDeviceBackendParity:
 
         host = SpfSolver("n0").build_route_db({"0": ls}, ps)
         dev = SpfSolver(
-            "n0", spf_backend=DeviceSpfBackend(min_device_nodes=1)
+            "n0", spf_backend=DeviceSpfBackend(min_device_nodes=1, min_device_sources=1)
         ).build_route_db({"0": ls}, ps)
         assert host.unicast_routes == dev.unicast_routes
         assert host.mpls_routes == dev.mpls_routes
