@@ -58,6 +58,8 @@ DEVICE_ROWS_PATH = "bench_device_rows.jsonl"
 ROW_TIMEOUT_S = float(os.environ.get("OPENR_BENCH_ROW_TIMEOUT_S", "900"))
 DEVICE_ATTEMPTS = int(os.environ.get("OPENR_BENCH_DEVICE_ATTEMPTS", "4"))
 RETRY_SLEEP_S = float(os.environ.get("OPENR_BENCH_RETRY_SLEEP_S", "60"))
+# split timed reps across two tunnel latency windows (see _time_device)
+WINDOW_SPLIT_S = float(os.environ.get("OPENR_BENCH_WINDOW_SPLIT_S", "45"))
 
 
 def _flush_details(details: dict) -> None:
@@ -69,7 +71,7 @@ def _flush_details(details: dict) -> None:
 
 
 def _time_device(
-    fn, reps: int, warmup: int = 2, window_split_s: float = 45.0
+    fn, reps: int, warmup: int = 2, window_split_s: float = WINDOW_SPLIT_S
 ) -> list[float]:
     """min-over-reps, with the reps SPLIT across two tunnel latency
     windows: the flat per-dispatch fee is bimodal on ~30s timescales, so
@@ -411,7 +413,11 @@ def bench_ksp_dual_metric_wan100k(topo, n_dests: int = 8) -> dict:
             v = int(topo.edge_src[ei])
         return edges
 
-    def run_plane(metric):
+    import jax.numpy as jnp
+
+    dests_dev = jnp.asarray(dests)
+
+    def run_plane(metric, adaptive=False):
         t0 = time.perf_counter()
         dist, dag, ok = runner.run_once(src, runner.hint, metric_plane=metric)
         dist = np.asarray(dist)
@@ -421,24 +427,45 @@ def bench_ksp_dual_metric_wan100k(topo, n_dests: int = 8) -> dict:
         for i, d in enumerate(dests):
             mask[i, trace_path_edges(dist[0], dag[0], d)] = False
         srcs = np.zeros(n_dests, dtype=np.int32)
-        # masked re-run batch: adaptive (the exclusion can deepen the
-        # relax), dist fetched — route building reads the k=2 distances
-        d2, _ = runner.forward(
+        # masked re-run batch (the k=2 edge-disjoint distances); the
+        # consumer reads ONLY the per-destination entries, so slice on
+        # device and fetch [D] ints instead of the [D, N] matrix.
+        # Warmup goes through forward() so hint adaptation keeps its
+        # saturation fallback AND the refine-down (a hand-rolled
+        # doubling loop here once inflated hint_masked for every later
+        # masked row on this shared runner); timed runs then execute at
+        # the refined hint.
+        if adaptive:
+            runner.forward(
+                srcs,
+                extra_edge_mask=mask,
+                want_dag=False,
+                metric_plane=metric,
+            )
+        d2, _, ok2 = runner.run_once(
             srcs,
+            runner.hint_masked,
             extra_edge_mask=mask,
             want_dag=False,
             metric_plane=metric,
         )
-        return (time.perf_counter() - t0) * 1e3
+        k2 = np.asarray(jnp.take(d2, dests_dev, axis=1).diagonal())
+        elapsed = (time.perf_counter() - t0) * 1e3
+        assert bool(ok2), "masked KSP batch missed its refined hint"
+        assert k2.shape == (n_dests,)
+        return elapsed
 
-    # warmup (learn hints on both planes, compile)
+    # warmup: learn hints on both planes AND under the masked batch
+    # (exclusions can deepen the relax; forward() adapts the hint)
     runner.forward(src)
     runner.forward(src, metric_plane=te_metric)
-    run_plane(topo.edge_metric)
-    run_plane(te_metric)
+    run_plane(topo.edge_metric, adaptive=True)
+    run_plane(te_metric, adaptive=True)
 
     times = []
-    for _ in range(3):
+    for i in range(3):
+        if i == 2:
+            time.sleep(WINDOW_SPLIT_S)
         total = run_plane(topo.edge_metric) + run_plane(te_metric)
         times.append(total)
 
@@ -520,7 +547,7 @@ def bench_srlg_whatif(topo, n_variants: int, reps: int, cpp_sample: int) -> dict
     # warmup learns the hint under the masked batch (distances only: the
     # what-if reachability analysis never reads the DAG)
     dist, _ = runner.forward(sources, extra_edge_mask=mask, want_dag=False)
-    hint = runner.hint
+    hint = runner.hint_masked
 
     # device-resident inputs for the timed runs: the scenario masks (tens
     # of MB at 10k variants) derive from topology state that already
@@ -655,7 +682,7 @@ def bench_tilfa(topo, source: int, reps: int) -> dict:
         max_degree=len(out_edges),
         runner=runner,
     )
-    hint = runner.hint
+    hint = runner.hint_masked
 
     def run():
         return runner.run_once(src_rows, hint, extra_edge_mask=survives)

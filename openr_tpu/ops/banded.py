@@ -483,6 +483,15 @@ class SpfRunner:
         self.depth = depth
         self.resid_rounds = resid_rounds
         self.hint = hint
+        # masked batches (KSP re-runs, what-if exclusions) reliably need
+        # DEEPER relax than unmasked ones, so they learn their own hint:
+        # a shared value would let one masked doubling inflate every
+        # later unmasked dispatch.  Masked consumers still share
+        # hint_masked with each other — callers must adapt through
+        # forward() (whose refine-down bounds the overshoot), never by
+        # hand-doubling (a bench row once did, tripling a later masked
+        # row on the same runner).
+        self.hint_masked = hint
         # small_allowed latches off on a saturation fallback; the metric
         # bound is re-checked per run_once because the mirror refreshes
         # edge_metric IN PLACE (csr.refresh) and an oversized metric must
@@ -513,9 +522,12 @@ class SpfRunner:
         import numpy as _np
 
         sources = jnp.asarray(_np.asarray(sources, dtype=_np.int32))
+        hint_attr = "hint" if extra_edge_mask is None else "hint_masked"
         doubled_from: Optional[int] = None
         while True:
-            sweeps = n_sweeps if n_sweeps is not None else self.hint
+            sweeps = (
+                n_sweeps if n_sweeps is not None else getattr(self, hint_attr)
+            )
             # the EFFECTIVE uint16 mode of this run — gated on the
             # metric plane actually used, exactly as run_once gates it
             eff_small = self.small_allowed and pick_small_dist(
@@ -557,13 +569,13 @@ class SpfRunner:
                             hi = mid
                         else:
                             lo = mid
-                    self.hint = hi
+                    setattr(self, hint_attr, hi)
                 break
             if n_sweeps is not None:
                 raise RuntimeError(
                     f"fixed {sweeps}-sweep run did not converge"
                 )
-            if eff_small and self.hint >= 32:
+            if eff_small and getattr(self, hint_attr) >= 32:
                 # saturation guard can also fail convergence; after two
                 # doublings under uint16, retry in int32 before doubling
                 # further.  Keyed on the failed run's effective mode —
@@ -574,7 +586,7 @@ class SpfRunner:
                 self.small_allowed = False
             else:
                 doubled_from = sweeps
-                self.hint = sweeps * 2
+                setattr(self, hint_attr, sweeps * 2)
         return (
             _np.asarray(dist),
             None if dag is None else _np.asarray(dag),
