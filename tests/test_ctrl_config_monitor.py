@@ -97,12 +97,24 @@ class TestWatchdog:
             watchdog.add_evb(evb)
             watchdog.check_once()
             assert not fired
-            # stall the loop
-            evb.run_in_event_base_thread  # noqa: B018
+            # stall the loop.  The callback delivery itself can lag under
+            # CPU contention (observed flake: >0.2s to reach the loop, so
+            # a single check saw a still-fresh heartbeat) — wait for the
+            # stall to actually begin, then poll the watchdog to a
+            # deadline instead of trusting one fixed-sleep check.
             blocker = threading.Event()
-            evb._loop.call_soon_threadsafe(lambda: blocker.wait(1.0))
-            time.sleep(0.4)
-            watchdog.check_once()
+            stalled = threading.Event()
+
+            def _stall():
+                stalled.set()
+                blocker.wait(5.0)
+
+            evb._loop.call_soon_threadsafe(_stall)
+            assert stalled.wait(5.0), "stall callback never reached the loop"
+            deadline = time.monotonic() + 5.0
+            while not fired and time.monotonic() < deadline:
+                time.sleep(0.05)
+                watchdog.check_once()
             assert fired and "stalled" in fired[0]
             blocker.set()
         finally:
