@@ -178,8 +178,10 @@ def bench_all_sources(topo, sources, reps, cpp_sample=None):
     # fake the wall number; a rolled batch is cost-equivalent fresh work
     rep_counter = [0]
     # shifts must stay below the batch length or a wrapped roll would
-    # re-dispatch a byte-identical input (replay-guard degeneracy)
-    max_calls = len(sources) - 1
+    # re-dispatch a byte-identical input (replay-guard degeneracy);
+    # a single-source batch has no distinct rolls — modulo-1 keeps the
+    # shift harmlessly constant instead of dividing by zero
+    max_calls = max(1, len(sources) - 1)
 
     def run():
         rep_counter[0] = rep_counter[0] % max_calls + 1
@@ -369,10 +371,17 @@ def bench_allsrc_full_wan100k(topo, n_prefixes: int = 1024) -> dict:
     per_sweep = max(t_kernel - t_one, 0.0) / max(hint - 1, 1)
     t_tax = max(t_one - 2 * per_sweep, 0.0)
     dist_k, _, _ = runner.run_once(dests, hint, want_dag=False)
+    # pre-stage the rolled distance inputs OUTSIDE the timed window: an
+    # in-window jnp.roll would add a second dispatch + a full-matrix
+    # copy to every sample and masquerade as bitmap cost
+    staged_dists = [jnp.roll(dist_k, i, axis=0) for i in range(1, 6)]
+    import jax as _jax
+
+    _jax.block_until_ready(staged_dists)
     t_bitmap = (
         _min_t(
             lambda i: asrc.ecmp_bitmap_from_reverse_dist(
-                jnp.roll(dist_k, i, axis=0),
+                staged_dists[i % len(staged_dists)],
                 out,
                 metric_d,
                 up_d,
@@ -587,16 +596,25 @@ def bench_srlg_whatif(topo, n_variants: int, reps: int, cpp_sample: int) -> dict
     # would time the tunnel's transfer path, not the what-if kernel
     mask_res = _jnp.asarray(mask)
     src_res = _jnp.asarray(sources)
+    # replay guard with ONE dispatch per timed rep: pre-stage a few
+    # distinct variant orders OUTSIDE the timed window (an in-window
+    # roll would add a dispatch + a full-mask copy to every rep)
+    n_staged = min(9, n_variants - 1)
+    assert n_staged >= 2, "need at least 2 distinct staged masks"
+    staged_masks = [
+        _jnp.roll(mask_res, i, axis=0) for i in range(1, n_staged + 1)
+    ]
+    import jax as _jax
+
+    _jax.block_until_ready(staged_masks)
     rep_counter = [0]
 
     def run():
-        # roll the variant axis per rep — fresh work, same cost (see
-        # bench_all_sources note on transport result replay)
         rep_counter[0] += 1
         return runner.run_once(
             src_res,
             hint,
-            extra_edge_mask=_jnp.roll(mask_res, rep_counter[0], axis=0),
+            extra_edge_mask=staged_masks[rep_counter[0] % n_staged],
             want_dag=False,
         )
 
@@ -707,8 +725,10 @@ def bench_tilfa(topo, source: int, reps: int) -> dict:
     degree = len(out_edges)
     deg_all = np.bincount(topo.edge_src[:e], minlength=topo.n_nodes)
     candidates = np.flatnonzero(deg_all == degree)
-    n_staged = 16
-    assert len(candidates) >= n_staged, "too few equal-degree sources"
+    # even 2 distinct staged questions defeat repeat-identical replay;
+    # 16 keeps every rep distinct on rich topologies
+    n_staged = min(16, len(candidates))
+    assert n_staged >= 2, "too few equal-degree sources to stage"
     staged = []
     for cand in candidates[:n_staged]:
         oe = np.where(topo.edge_src[:e] == cand)[0].astype(np.int32)
@@ -767,9 +787,16 @@ def bench_tilfa(topo, source: int, reps: int) -> dict:
         )
         np.testing.assert_array_equal(dist[d, : topo.n_nodes], cdist[0])
 
+    # every staged candidate must converge at the source-learned hint
+    # BEFORE timing: the timed reps cycle through them, and an
+    # unconverged candidate would time cheaper, unfinished work
+    for srcs_i, mask_i in staged:
+        _, _, ok_i = runner.run_once(
+            srcs_i, hint, extra_edge_mask=mask_i
+        )
+        assert bool(ok_i), "staged TI-LFA candidate missed the hint"
+
     times = _time_device(run, reps)
-    _, _, ok = run()
-    assert bool(ok), "timed TI-LFA runs did not reach the fixed point"
 
     import jax.numpy as jnp
 
@@ -1247,6 +1274,10 @@ DEVICE_NOTES = [
     "backend wins their WALL time at 1k-node scale; see "
     "docs/TPU_DESIGN.md 'Host/device crossover' for the analysis and "
     "the production batching posture",
+    "every timed rep dispatches a DISTINCT pre-staged input (rolled "
+    "batches / masks / equal-degree sources): repeat-identical "
+    "dispatches can be served from a transport-level result cache, "
+    "which fabricated sub-ms walls for 100k kernels before the guard",
 ]
 
 

@@ -499,6 +499,74 @@ class TestRouteDelta:
         assert solver.my_node_name == "1"  # restored
 
 
+class TestDispatchPolicy:
+    """The measured batch-size dispatch policy (round 4): single
+    questions go to the host memo, batches to the device — see
+    DeviceSpfBackend docstring for the numbers behind the defaults."""
+
+    @staticmethod
+    def _state(n_side=16):
+        from openr_tpu.utils.topo import grid_topology
+
+        dbs = grid_topology(n_side)
+        ls = LinkState()
+        for db in dbs:
+            ls.update_adjacency_database(db)
+        return dbs, ls
+
+    def test_single_question_served_by_host(self):
+        dbs, ls = self._state()
+        be = DeviceSpfBackend()  # shipped defaults
+        res = be.get_spf_result(ls, dbs[0].this_node_name)
+        host = ls.run_spf(dbs[0].this_node_name)
+        assert {n: r.metric for n, r in res.items()} == {
+            n: r.metric for n, r in host.items()
+        }
+        # no device mirror was built for a single-question flow
+        assert len(be._mirrors) == 0
+
+    def test_batch_prefetch_uses_device_and_serves_singles(self):
+        dbs, ls = self._state()
+        be = DeviceSpfBackend()
+        sources = [d.this_node_name for d in dbs[:64]]
+        be.prefetch(ls, sources)
+        assert len(be._mirrors) == 1  # device mirror built
+        # a later single question hits the batch-populated cache
+        res = be.get_spf_result(ls, sources[3])
+        host = ls.run_spf(sources[3])
+        assert {n: r.metric for n, r in res.items()} == {
+            n: r.metric for n, r in host.items()
+        }
+
+    def test_small_batch_prefetch_falls_back_to_host(self):
+        dbs, ls = self._state()
+        be = DeviceSpfBackend()
+        be.prefetch(ls, [d.this_node_name for d in dbs[:4]])
+        assert len(be._mirrors) == 0  # below min_device_sources
+        # but the cache still serves the host-computed results
+        res = be.get_spf_result(ls, dbs[1].this_node_name)
+        host = ls.run_spf(dbs[1].this_node_name)
+        assert {n: r.metric for n, r in res.items()} == {
+            n: r.metric for n, r in host.items()
+        }
+
+    def test_tiny_topology_always_host(self):
+        dbs, ls = self._state(4)  # 16 nodes < min_device_nodes
+        be = DeviceSpfBackend()
+        be.prefetch(ls, [d.this_node_name for d in dbs])
+        assert len(be._mirrors) == 0
+
+    def test_forced_device_overrides_policy(self):
+        dbs, ls = self._state()
+        be = DeviceSpfBackend(min_device_nodes=1, min_device_sources=1)
+        res = be.get_spf_result(ls, dbs[0].this_node_name)
+        assert len(be._mirrors) == 1
+        host = ls.run_spf(dbs[0].this_node_name)
+        assert {n: r.metric for n, r in res.items()} == {
+            n: r.metric for n, r in host.items()
+        }
+
+
 class TestDeviceBackendParity:
     @pytest.mark.parametrize("seed", [0, 1, 2])
     def test_random_topology_same_routes(self, seed):
