@@ -107,7 +107,10 @@ class TestWatchdog:
 
             def _stall():
                 stalled.set()
-                blocker.wait(5.0)
+                # the stall must OUTLIVE the polling deadline below with
+                # margin, or a contended tail can release the loop and
+                # refresh the heartbeat mid-poll
+                blocker.wait(20.0)
 
             evb._loop.call_soon_threadsafe(_stall)
             assert stalled.wait(5.0), "stall callback never reached the loop"
@@ -116,8 +119,10 @@ class TestWatchdog:
                 time.sleep(0.05)
                 watchdog.check_once()
             assert fired and "stalled" in fired[0]
-            blocker.set()
         finally:
+            # ALWAYS release the loop: an assertion failure above must
+            # not leave the loop thread in blocker.wait through teardown
+            blocker.set()
             evb.stop()
             evb.wait_until_stopped(5)
 

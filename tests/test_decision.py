@@ -267,3 +267,56 @@ class TestDecision:
             lambda: set(decision.prefix_state.prefixes)
         ).result()
         assert PFX2 not in prefixes
+
+
+class TestNoOpPublications:
+    """Ancestors: DecisionTestFixture.NoSpfOnIrrelevantPublication
+    (DecisionTest.cpp:6179) and NoSpfOnDuplicatePublication (:6212)."""
+
+    @staticmethod
+    def _assert_no_update_before_sentinel(kvq, reader, decision):
+        """Non-vacuous negative check: push a known-relevant sentinel
+        prefix AFTER the publication under test; the NEXT update must be
+        the sentinel's alone, proving the tested publication was
+        processed and produced nothing (the sibling pattern in
+        test_self_redistribution_ignored)."""
+        k, v = prefix_val("3", PFX2)
+        kvq.push(Publication(key_vals={k: v}, area="0"))
+        update = get_update(reader)
+        # dict[prefix -> RibUnicastEntry]: the sentinel's prefix alone
+        assert list(update.unicast_routes_to_update) == [PFX2]
+
+    def test_no_rebuild_on_irrelevant_publication(self, harness):
+        kvq, _staticq, reader, decision = harness
+        kvq.push(square_publication())
+        get_update(reader)  # initial convergence
+
+        # wrong markers: "adj2:" / "adji2:" are NOT the adj/prefix
+        # namespaces — the module must ignore them entirely
+        kv = {
+            "adj2:1": adj_val("1", [adj("1", "2")]),
+            "adji2:2": adj_val("2", [adj("2", "1")]),
+        }
+        before_adj = decision.counters.get("decision.adj_db_update", 0)
+        kvq.push(Publication(key_vals=kv, area="0"))
+        self._assert_no_update_before_sentinel(kvq, reader, decision)
+        assert (
+            decision.counters.get("decision.adj_db_update", 0) == before_adj
+        )
+
+    def test_no_rebuild_on_duplicate_publication(self, harness):
+        kvq, _staticq, reader, decision = harness
+        pub = square_publication()
+        kvq.push(pub)
+        get_update(reader)  # initial convergence
+
+        # byte-identical re-publication: values PARSE (adj counter must
+        # increment, proving processing) but nothing changed — no
+        # DecisionRouteUpdate may be emitted before the sentinel's
+        before_adj = decision.counters.get("decision.adj_db_update", 0)
+        kvq.push(square_publication())
+        self._assert_no_update_before_sentinel(kvq, reader, decision)
+        assert (
+            decision.counters.get("decision.adj_db_update", 0)
+            == before_adj + 4
+        )
