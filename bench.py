@@ -418,12 +418,17 @@ def bench_allsrc_full_wan100k(topo, n_prefixes: int = 1024) -> dict:
 
 def bench_ksp_dual_metric_wan100k(topo, n_dests: int = 8) -> dict:
     """BASELINE config #3: dual-metric (IGP + TE) KSP at 100k nodes.
-    For each cost plane, k=2 edge-disjoint paths to `n_dests`
-    destinations: one base SPF per plane, host path trace, one masked
-    batch per plane for the disjoint re-runs — 4 device dispatches
-    total.  The C++ baseline runs the same (1 + D) Dijkstras per plane
-    sequentially (sampled + scaled like the other 100k rows)."""
+    Round-5 formulation: base SPF, ON-DEVICE path trace, and the masked
+    k=2 edge-disjoint re-run batch for BOTH cost planes run as ONE fused
+    dispatch (ops.ksp.fused_ksp2_banded) — round 4's 4-dispatch chain
+    with host traces between paid the flat transport fee per hop and
+    lost 3.1x on wall.  The C++ baseline runs the same (1 + D) Dijkstras
+    per plane sequentially (sampled + scaled like the other 100k rows)."""
+    import jax
+
     from benchmarks import cpp_baseline
+    from openr_tpu.ops.ksp import FusedKsp2Runner
+    from openr_tpu.ops.protection import build_reverse_edge_ids
 
     e = topo.n_edges
     rng = np.random.default_rng(17)
@@ -432,83 +437,68 @@ def bench_ksp_dual_metric_wan100k(topo, n_dests: int = 8) -> dict:
     dests = rng.choice(
         np.arange(1, topo.n_nodes), size=n_dests, replace=False
     ).astype(np.int32)
-    src = np.zeros(1, dtype=np.int32)
     runner = topo.runner
+    planes = [topo.edge_metric, te_metric]
+    rev = np.asarray(
+        build_reverse_edge_ids(topo.edge_src[:e], topo.edge_dst[:e])
+    )
+    fk = FusedKsp2Runner(runner, topo.edge_dst, e, topo.n_nodes, rev, planes)
 
-    # edges are sorted by (dst, src): in-edges of v are one contiguous
-    # run, so each trace hop is a binary search + tiny scan
-    dst_sorted = topo.edge_dst[:e]
+    # warmup: learn base + masked hints through the adaptive fused path
+    res = fk.run(0, dests, adaptive=True)
 
-    def trace_path_edges(dist_row, dag_row, dest):
-        """One shortest path dest -> source by greedy predecessor walk
-        over the SP-DAG (bounded by path hop count)."""
-        edges = []
-        v = int(dest)
-        while dist_row[v] > 0:
-            lo = int(np.searchsorted(dst_sorted, v))
-            hi = int(np.searchsorted(dst_sorted, v + 1))
-            cand = lo + np.flatnonzero(dag_row[lo:hi])
-            assert cand.size, "broken DAG trace"
-            ei = int(cand[0])
-            edges.append(ei)
-            v = int(topo.edge_src[ei])
-        return edges
-
-    import jax.numpy as jnp
-
-    dests_dev = jnp.asarray(dests)
-
-    def run_plane(metric, adaptive=False):
-        t0 = time.perf_counter()
-        dist, dag, ok = runner.run_once(src, runner.hint, metric_plane=metric)
-        dist = np.asarray(dist)
-        dag = np.asarray(dag)
-        assert bool(ok)
-        mask = np.ones((n_dests, topo.edge_capacity), dtype=bool)
-        for i, d in enumerate(dests):
-            mask[i, trace_path_edges(dist[0], dag[0], d)] = False
-        srcs = np.zeros(n_dests, dtype=np.int32)
-        # masked re-run batch (the k=2 edge-disjoint distances); the
-        # consumer reads ONLY the per-destination entries, so slice on
-        # device and fetch [D] ints instead of the [D, N] matrix.
-        # Warmup goes through forward() so hint adaptation keeps its
-        # saturation fallback AND the refine-down (a hand-rolled
-        # doubling loop here once inflated hint_masked for every later
-        # masked row on this shared runner); timed runs then execute at
-        # the refined hint.
-        if adaptive:
-            runner.forward(
-                srcs,
-                extra_edge_mask=mask,
-                want_dag=False,
-                metric_plane=metric,
-            )
-        d2, _, ok2 = runner.run_once(
-            srcs,
-            runner.hint_masked,
-            extra_edge_mask=mask,
-            want_dag=False,
-            metric_plane=metric,
+    # parity BEFORE timing: k1 vs the C++ oracle; k2 vs a host Dijkstra
+    # run under the device's own exclusions; excluded edges must form a
+    # shortest path (sum of metrics == k1)
+    for p, metric in enumerate(planes):
+        r = res[p]
+        _, cd = cpp_baseline.spf_all_sources(
+            topo.n_nodes,
+            topo.edge_src[:e],
+            topo.edge_dst[:e],
+            metric[:e],
+            topo.edge_up[:e],
+            topo.node_overloaded[: topo.n_nodes],
+            np.zeros(1, np.int32),
+            want_dist=True,
         )
-        k2 = np.asarray(jnp.take(d2, dests_dev, axis=1).diagonal())
-        elapsed = (time.perf_counter() - t0) * 1e3
-        assert bool(ok2), "masked KSP batch missed its refined hint"
-        assert k2.shape == (n_dests,)
-        return elapsed
+        np.testing.assert_array_equal(np.asarray(r.k1), cd[0, dests])
+        excl = np.asarray(r.excl)
+        for i in range(0, n_dests, max(1, n_dests // 2)):
+            ee = excl[i]
+            ee = ee[ee < e]
+            assert metric[ee].sum() == cd[0, dests[i]], "trace not shortest"
+            up = topo.edge_up.copy()
+            up[ee] = False
+            rv = rev[ee]
+            up[rv[rv >= 0]] = False
+            _, cd2 = cpp_baseline.spf_all_sources(
+                topo.n_nodes,
+                topo.edge_src[:e],
+                topo.edge_dst[:e],
+                metric[:e],
+                up[:e],
+                topo.node_overloaded[: topo.n_nodes],
+                np.zeros(1, np.int32),
+                want_dist=True,
+            )
+            assert int(np.asarray(r.k2)[i]) == int(cd2[0, dests[i]])
 
-    # warmup: learn hints on both planes AND under the masked batch
-    # (exclusions can deepen the relax; forward() adapts the hint)
-    runner.forward(src)
-    runner.forward(src, metric_plane=te_metric)
-    run_plane(topo.edge_metric, adaptive=True)
-    run_plane(te_metric, adaptive=True)
+    def run_fused(rep: int) -> float:
+        # replay guard: distinct destination order per rep
+        t0 = time.perf_counter()
+        out = fk.run(0, np.roll(dests, rep + 1), adaptive=False)
+        jax.block_until_ready([r.k2 for r in out])
+        elapsed = (time.perf_counter() - t0) * 1e3
+        for r in out:
+            assert bool(r.ok_base) and bool(r.ok_masked) and bool(r.trace_ok)
+        return elapsed
 
     times = []
     for i in range(3):
         if i == 2:
             time.sleep(WINDOW_SPLIT_S)
-        total = run_plane(topo.edge_metric) + run_plane(te_metric)
-        times.append(total)
+        times.append(run_fused(i))
 
     # C++ baseline: 1 base + 2 sampled masked Dijkstras per plane, masked
     # runs scaled to D
@@ -521,7 +511,7 @@ def bench_ksp_dual_metric_wan100k(topo, n_dests: int = 8) -> dict:
             metric[:e],
             topo.edge_up[:e],
             topo.node_overloaded[: topo.n_nodes],
-            src,
+            np.zeros(1, dtype=np.int32),
             want_dist=True,
         )
         cpp_ms += secs * 1e3
@@ -551,9 +541,11 @@ def bench_ksp_dual_metric_wan100k(topo, n_dests: int = 8) -> dict:
         "cpp_baseline_ms": round(cpp_ms, 3),
         "cpp_scaled": True,
         "note": (
-            "per plane: one base SPF + one masked batch of k=2 "
-            "edge-disjoint re-runs; device time includes the host path "
-            "traces between dispatches"
+            "ONE fused dispatch for both planes: base SPF + on-device "
+            "path trace + masked k=2 edge-disjoint batch "
+            "(ops.ksp.fused_ksp2_banded); k1/k2 parity-checked against "
+            "the C++ oracle under the device's own exclusions before "
+            "timing"
         ),
     }
 

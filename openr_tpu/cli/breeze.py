@@ -133,6 +133,25 @@ def cmd_decision_routes(client: CtrlClient, args) -> None:
             print(f"> {label} via {nhs}")
 
 
+def cmd_decision_fleet_routes(client: CtrlClient, args) -> None:
+    """Fleet-wide route dump: every router's unicast routes from ONE
+    reduced all-sources device round (getFleetRoutes)."""
+    dbs = client.call("getFleetRoutes", nodes=args.nodes or None)
+    for node in sorted(dbs):
+        db = dbs[node]
+        print(
+            f"== {node}: {len(db.unicast_routes)} unicast, "
+            f"{len(db.mpls_routes)} mpls =="
+        )
+        if not args.summary:
+            for prefix, entry in sorted(db.unicast_routes.items()):
+                nhs = ", ".join(
+                    f"{nh.neighbor_node_name or nh.address}"
+                    for nh in sorted(entry.nexthops, key=lambda n: n.address)
+                )
+                print(f"> {prefix} via {nhs}")
+
+
 def cmd_decision_adj(client: CtrlClient, args) -> None:
     dbs = client.call(
         "getDecisionAdjacenciesFiltered", areas=[args.area] if args.area else None
@@ -609,6 +628,10 @@ def build_parser() -> argparse.ArgumentParser:
     p = dec.add_parser("routes")
     p.add_argument("--node", default="")
     p.set_defaults(fn=cmd_decision_routes)
+    p = dec.add_parser("fleet-routes")
+    p.add_argument("--nodes", nargs="*")
+    p.add_argument("--summary", action="store_true")
+    p.set_defaults(fn=cmd_decision_fleet_routes)
     p = dec.add_parser("adj")
     p.add_argument("--area", default="")
     p.set_defaults(fn=cmd_decision_adj)

@@ -123,6 +123,12 @@ class OpenrCtrlHandler:
         m["getRouteDb"] = lambda p: self._need(
             self.decision, "decision"
         ).get_route_db(p.get("node", ""))
+        # fleet-wide route dump from the reduced all-sources product (new
+        # capability vs the reference's one-node-at-a-time
+        # getRouteDbComputed, Decision.cpp:1510-1530)
+        m["getFleetRoutes"] = lambda p: self._need(
+            self.decision, "decision"
+        ).get_fleet_route_dbs(p.get("nodes"))
         m["getDecisionAdjacenciesFiltered"] = lambda p: self._need(
             self.decision, "decision"
         ).get_adjacency_databases(

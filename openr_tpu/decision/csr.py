@@ -249,6 +249,9 @@ class CsrTopology:
         for name, i in self.node_id.items():
             self.node_overloaded[i] = ls.is_node_overloaded(name)
         self.version = ls.version
+        if self._runner is not None:
+            # a staged (device-pinned) runner would read pre-refresh state
+            self._runner.unstage()
         return True
 
     # -- SPF execution ------------------------------------------------------

@@ -406,14 +406,64 @@ class Decision(OpenrEventBase):
     # -- thread-safe control API (reference: Decision.cpp:1510-1680) ---------
 
     def get_route_db(self, node_name: str = "") -> DecisionRouteDb:
-        """Compute any node's routes (reference: getDecisionRouteDb)."""
+        """Compute any node's routes (reference: getDecisionRouteDb).
+        Other-node queries go through the fleet-product path
+        (spf_solver.any_node_route_db): a warm reduced all-sources view
+        answers them with zero device work."""
 
         def _compute() -> DecisionRouteDb:
             target = node_name or self.my_node_name
-            db = self.spf_solver.build_route_db(
-                self.area_link_states, self.prefix_state, my_node_name=target
-            )
+            if target != self.my_node_name:
+                db = self.spf_solver.any_node_route_db(
+                    self.area_link_states, self.prefix_state, target
+                )
+            else:
+                db = self.spf_solver.build_route_db(
+                    self.area_link_states,
+                    self.prefix_state,
+                    my_node_name=target,
+                )
             return db if db is not None else DecisionRouteDb()
+
+        return self.run_in_event_base_thread(_compute).result()
+
+    # Fleet dumps build one DecisionRouteDb per node and serialize as a
+    # single response: an unbounded dump at 100k-node scale is a
+    # multi-GB allocation on the Decision thread (the Watchdog RSS
+    # limit would abort the daemon).  Operators page with `nodes=`.
+    MAX_FLEET_DUMP_NODES = 8192
+
+    def get_fleet_route_dbs(
+        self, nodes: Optional[list[str]] = None
+    ) -> dict[str, DecisionRouteDb]:
+        """Fleet-wide route dump from ONE reverse-SSSP device round per
+        area (spf_solver.fleet_route_dbs; consumer of ops.allsources).
+        `nodes` defaults to every known node, bounded by
+        MAX_FLEET_DUMP_NODES."""
+
+        def _compute() -> dict[str, DecisionRouteDb]:
+            if nodes is None:
+                total = len(
+                    {
+                        n
+                        for ls in self.area_link_states.values()
+                        for n in ls.node_names
+                    }
+                )
+                if total > self.MAX_FLEET_DUMP_NODES:
+                    raise ValueError(
+                        f"fleet dump of {total} nodes exceeds "
+                        f"{self.MAX_FLEET_DUMP_NODES}; pass an explicit "
+                        "node list (breeze: --nodes)"
+                    )
+            elif len(nodes) > self.MAX_FLEET_DUMP_NODES:
+                raise ValueError(
+                    f"fleet dump of {len(nodes)} nodes exceeds "
+                    f"{self.MAX_FLEET_DUMP_NODES}"
+                )
+            return self.spf_solver.fleet_route_dbs(
+                self.area_link_states, self.prefix_state, nodes=nodes
+            )
 
         return self.run_in_event_base_thread(_compute).result()
 

@@ -1,0 +1,279 @@
+"""Fleet route view: the daemon consumer of the reduced all-sources product.
+
+One reverse-SSSP device round (openr_tpu.ops.allsources) answers every
+router's route build toward the destination set that route construction
+actually reads — the prefix-advertising nodes plus every labeled node.
+This is the in-daemon consumer of the round-4 flagship product; the
+reference's equivalent consumer is the per-prefix route build
+(openr/decision/Decision.cpp:615-793, createRouteForPrefix reads best-entry
+node distances) and the any-node ctrl query
+(openr/decision/Decision.cpp:1510-1530, getDecisionRouteDb).
+
+Why the product suffices: the reverse distances dist[p, v] == dist(v -> p)
+cover EVERY router v, so for any router `me` the route build has
+- reachability:  dist(me -> advertiser) < INF
+- best-metric:   min over advertisers of dist(me -> advertiser)
+- LFA-free ECMP: link (me -l-> u) is a next hop toward p iff
+                 metric(l) + dist(u -> p) == dist(me -> p)
+                 (openr/decision/Decision.cpp:1296-1300), with the drain
+                 exception (overloaded u only as the destination itself,
+                 dist(u -> p) == 0) — all reads of the same [P, N] matrix.
+The fused [N, P, W] bitmap is the device-side fleet-wide evaluation of the
+same condition (ops.allsources.ecmp_bitmap_from_reverse_dist); the host
+hooks in SpfSolver evaluate it per link so parallel links keep their
+per-link metric semantics, and tests cross-check the two.
+
+A view is a SNAPSHOT of one LinkState version: the runtime arrays are
+copied at build time (the CSR mirror refreshes its arrays in place), and
+the cache invalidates on version or destination-set change.
+"""
+
+from __future__ import annotations
+
+import logging
+import weakref
+from typing import Optional
+
+import numpy as np
+
+from .link_state import LinkState
+
+# mirrors ops.sssp.INF32 (a plain int here so importing the decision layer
+# does not pull jax; tests assert the two stay equal)
+INF32 = 1 << 30
+
+log = logging.getLogger(__name__)
+
+
+def _reverse_runner(csr, hint: Optional[int] = None):
+    """SpfRunner over the REVERSED directed edges of a CsrTopology
+    snapshot (same construction as benchmarks.synthetic.reversed_topology,
+    but from the daemon's mirror).  `hint` seeds the learned fixed-sweep
+    count — the relax depth is a property of the topology shape, so
+    re-learning it by doubling on every rebuild would pay failed
+    full-P-source dispatches per link flap (DeviceSpfBackend._hint_by_shape
+    discipline)."""
+    from ..ops.banded import SpfRunner, build_banded
+    from ..ops.sssp import build_ell
+
+    e = csr.n_edges
+    src = csr.edge_dst[:e].copy()
+    dst = csr.edge_src[:e].copy()
+    met = csr.edge_metric[:e].copy()
+    up = csr.edge_up[:e].copy()
+    order = np.lexsort((src, dst))
+    pad_node = csr.node_capacity - 1
+    edge_src = np.full(csr.edge_capacity, pad_node, dtype=np.int32)
+    edge_dst = np.full(csr.edge_capacity, pad_node, dtype=np.int32)
+    edge_metric = np.ones(csr.edge_capacity, dtype=np.int32)
+    edge_up = np.zeros(csr.edge_capacity, dtype=bool)
+    edge_src[:e] = src[order]
+    edge_dst[:e] = dst[order]
+    edge_metric[:e] = met[order]
+    edge_up[:e] = up[order]
+    node_overloaded = csr.node_overloaded.copy()
+    ell = build_ell(
+        edge_src, edge_dst, edge_metric, edge_up, node_overloaded, e
+    )
+    banded = build_banded(edge_src, edge_dst, e, csr.n_nodes)
+    runner = SpfRunner(
+        ell,
+        banded,
+        edge_src,
+        edge_dst,
+        edge_metric,
+        edge_up,
+        node_overloaded,
+        e,
+    )
+    if hint is not None:
+        runner.hint = hint
+    # snapshot arrays are immutable for the view's lifetime: pin them
+    # device-resident so repeat computes/queries skip the re-upload
+    runner.stage()
+    return runner
+
+
+class FleetRouteView:
+    """Snapshot answering dist/ECMP queries for every (router, dest) pair.
+
+    `dest_names` must cover every node route construction asks distances
+    to: prefix advertisers + labeled nodes (fleet_destinations)."""
+
+    def __init__(self, csr, dest_names: list[str]) -> None:
+        self.csr = csr
+        self.version = csr.version
+        self.dest_names = list(dest_names)
+        self.p_index = {name: i for i, name in enumerate(self.dest_names)}
+        self._node_id = dict(csr.node_id)
+        # runtime-state snapshot for the host-side per-link checks
+        self._overloaded = csr.node_overloaded.copy()
+        self._dist_dev = None  # jax [P, N*]
+        self._bitmap_dev = None  # jax [N, P, W]
+        self._out = None  # ops.allsources.OutEll
+        self._cols: dict[int, np.ndarray] = {}  # node id -> [P] int32
+        self.converged = False
+        self.sweep_hint: Optional[int] = None
+
+    # -- device round --------------------------------------------------------
+
+    def compute(self, hint_seed: Optional[int] = None) -> None:
+        """ONE device round: P-source reverse SSSP + fused ECMP bitmaps.
+        `hint_seed` carries the previous view's learned sweep count across
+        topology versions (same-shape seeding)."""
+        from ..ops import allsources as asrc
+
+        dest_ids = np.asarray(
+            [self._node_id[d] for d in self.dest_names], dtype=np.int32
+        )
+        runner = _reverse_runner(self.csr, hint=hint_seed)
+        self._out = asrc.build_out_ell(
+            self.csr.edge_src,
+            self.csr.edge_dst,
+            self.csr.n_edges,
+            self.csr.n_nodes,
+            out_slot=self.csr.out_slot,
+        )
+        dist, bitmap, ok = asrc.reduced_all_sources(
+            dest_ids,
+            runner,
+            self._out,
+            self.csr.edge_metric,
+            self.csr.edge_up,
+            self.csr.node_overloaded,
+        )
+        assert bool(ok), "fleet reverse SSSP did not reach its fixed point"
+        self._dist_dev = dist
+        self._bitmap_dev = bitmap
+        self.converged = True
+        self.sweep_hint = runner.hint
+
+    # -- host queries --------------------------------------------------------
+
+    def covers(self, node: str) -> bool:
+        return node in self._node_id
+
+    def is_dest(self, node: str) -> bool:
+        return node in self.p_index
+
+    def _col(self, node: str) -> np.ndarray:
+        """dist(node -> every dest), [P] int32; fetched lazily and cached
+        (one device gather per new node — a ctrl query touches only the
+        queried router and its neighbors)."""
+        i = self._node_id[node]
+        hit = self._cols.get(i)
+        if hit is None:
+            hit = np.asarray(self._dist_dev[:, i])
+            self._cols[i] = hit
+        return hit
+
+    def prefetch_cols(self, nodes: list[str]) -> None:
+        """Fetch many columns in one device gather (fleet dumps)."""
+        import jax.numpy as jnp
+
+        ids = [self._node_id[n] for n in nodes if n in self._node_id]
+        missing = [i for i in ids if i not in self._cols]
+        if not missing:
+            return
+        cols = np.asarray(
+            jnp.take(self._dist_dev, jnp.asarray(missing, jnp.int32), axis=1)
+        )
+        for k, i in enumerate(missing):
+            self._cols[i] = cols[:, k]
+
+    def dist(self, node: str, dest: str) -> int:
+        """dist(node -> dest); INF32 when unreachable."""
+        d = self._col(node)[self.p_index[dest]]
+        return int(d)
+
+    def reachable(self, node: str, dest: str) -> bool:
+        return self.dist(node, dest) < INF32
+
+    def is_overloaded_id(self, node: str) -> bool:
+        return bool(self._overloaded[self._node_id[node]])
+
+    def next_hop_neighbors(self, node: str, dest: str) -> set[str]:
+        """Decode the device bitmap row: slot-named ECMP next-hop
+        neighbors of `node` toward `dest` (unique neighbors; parallel
+        links share a slot).  Used by tests/dumps to cross-check the
+        host-side per-link evaluation."""
+        i = self._node_id[node]
+        p = self.p_index[dest]
+        words = np.asarray(self._bitmap_dev[i, p])
+        slot_names = self.csr.slot_neighbors(node)
+        out: set[str] = set()
+        for w in range(words.shape[0]):
+            bits = int(words[w])
+            base = 32 * w
+            while bits:
+                b = bits & -bits
+                out.add(slot_names[base + b.bit_length() - 1])
+                bits ^= b
+        return out
+
+
+def fleet_destinations(ls: LinkState, prefix_state) -> list[str]:
+    """The destination set route construction reads distances to, for one
+    area: prefix-advertising nodes (reachability filter + unicast ECMP,
+    Decision.cpp:445-613) + labeled nodes (MPLS node-label routes,
+    Decision.cpp:655-745).  Sorted for a deterministic cache key."""
+    dests: set[str] = set()
+    for entries in prefix_state.prefixes.values():
+        for node, _area in entries:
+            if ls.has_node(node):
+                dests.add(node)
+    for node, adj_db in ls.get_adjacency_databases().items():
+        if adj_db.node_label != 0 and ls.has_node(node):
+            dests.add(node)
+    return sorted(dests)
+
+
+class FleetViewCache:
+    """Per-LinkState cached FleetRouteView, invalidated on topology
+    version or destination-set change.  Weakly keyed like
+    DeviceSpfBackend's mirrors (ids recycle after GC)."""
+
+    def __init__(self) -> None:
+        self._views: "weakref.WeakKeyDictionary[LinkState, FleetRouteView]" = (
+            weakref.WeakKeyDictionary()
+        )
+        # learned reverse-relax sweep hints keyed by topology shape
+        # (node/edge counts — the DeviceSpfBackend._hint_key discipline):
+        # a rebuilt view of a same-shaped topology starts from the learned
+        # count instead of re-learning it by doubling
+        self._hints: dict[tuple[int, int], int] = {}
+
+    def is_warm(self, ls: LinkState, dest_names: list[str]) -> bool:
+        """True when a cached view already answers this (version, dests) —
+        i.e. using the fleet path costs zero device work."""
+        cached = self._views.get(ls)
+        return (
+            cached is not None
+            and cached.version == ls.version
+            and cached.dest_names == list(dest_names)
+        )
+
+    def view(
+        self, ls: LinkState, dest_names: list[str], csr=None
+    ) -> Optional[FleetRouteView]:
+        """Computed view for this (version, dests); None when empty."""
+        if not dest_names:
+            return None
+        if self.is_warm(ls, dest_names):
+            return self._views[ls]
+        if csr is None:
+            from .csr import CsrTopology
+
+            csr = CsrTopology.from_link_state(ls)
+        elif csr.version != ls.version:
+            csr.refresh(ls)
+        view = FleetRouteView(csr, dest_names)
+        key = (csr.n_nodes, csr.n_edges)
+        view.compute(hint_seed=self._hints.get(key))
+        if view.sweep_hint is not None:
+            # max-merge, like DeviceSpfBackend._harvest_hint
+            self._hints[key] = max(
+                self._hints.get(key, 0), view.sweep_hint
+            )
+        self._views[ls] = view
+        return view

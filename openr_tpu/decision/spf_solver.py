@@ -36,6 +36,12 @@ from ..types import (
     UnicastRoute,
     normalize_prefix,
 )
+from .fleet import (
+    INF32 as FLEET_INF,
+    FleetRouteView,
+    FleetViewCache,
+    fleet_destinations,
+)
 from .link_state import LinkState, Path, SpfResult
 from .prefix_state import NodeAndArea, PrefixEntries, PrefixState
 from .rib import DecisionRouteDb, RibMplsEntry, RibUnicastEntry
@@ -451,6 +457,10 @@ class SpfSolver:
         self.bgp_dry_run = bgp_dry_run
         self.enable_best_route_selection = enable_best_route_selection
         self.spf = spf_backend or HostSpfBackend()
+        # fleet-product views (reduced all-sources reverse-SSSP consumer;
+        # active per build via build_route_db(fleet_views=...))
+        self.fleet = FleetViewCache()
+        self._fleet_views: dict[str, FleetRouteView] = {}
         # static route overlays (reference: Decision.cpp:372-425)
         self.static_unicast_routes: dict[str, list[NextHop]] = {}
         self.static_mpls_routes: dict[int, list[NextHop]] = {}
@@ -523,6 +533,24 @@ class SpfSolver:
         # keep entries of reachable nodes only (per area)
         prefix_entries: PrefixEntries = dict(all_prefix_entries)
         for area, link_state in area_link_states.items():
+            view = self._fleet_views.get(area)
+            if view is not None and view.covers(self.my_node_name) and all(
+                view.is_dest(node)
+                for (node, parea) in prefix_entries
+                if parea == area and view.covers(node)
+            ):
+                # fleet product answers reachability without a per-source
+                # SPF: dist(me -> advertiser) < INF (module doc, fleet.py)
+                prefix_entries = {
+                    (node, parea): entry
+                    for (node, parea), entry in prefix_entries.items()
+                    if area != parea
+                    or (
+                        view.covers(node)
+                        and view.reachable(self.my_node_name, node)
+                    )
+                }
+                continue
             my_spf = self.spf.get_spf_result(link_state, self.my_node_name)
             prefix_entries = {
                 (node, parea): entry
@@ -959,6 +987,17 @@ class SpfSolver:
         nexthop_nodes: dict[tuple[str, str], float] = {}
         shortest = float("inf")
         for area, link_state in area_link_states.items():
+            view = self._fleet_views.get(area)
+            if view is not None and self._fleet_usable(view, dst_node_areas):
+                shortest = self._fleet_next_hops_with_metric(
+                    view,
+                    link_state,
+                    dst_node_areas,
+                    per_destination,
+                    shortest,
+                    nexthop_nodes,
+                )
+                continue
             spf = self.spf.get_spf_result(link_state, self.my_node_name)
             min_metric, min_cost_nodes = self._get_min_cost_nodes(
                 spf, dst_node_areas
@@ -977,6 +1016,81 @@ class SpfSolver:
                         shortest - spf[nh_name].metric
                     )
         return shortest, nexthop_nodes
+
+    def _fleet_usable(
+        self, view: FleetRouteView, dst_node_areas: set[NodeAndArea]
+    ) -> bool:
+        """The fleet snapshot can answer this query iff it covers the
+        querying node and every destination it knows about is in the
+        product's destination set (nodes outside the area's graph are
+        skipped by both paths identically)."""
+        return view.covers(self.my_node_name) and all(
+            view.is_dest(node) or not view.covers(node)
+            for node, _area in dst_node_areas
+        )
+
+    def _fleet_next_hops_with_metric(
+        self,
+        view: FleetRouteView,
+        link_state: LinkState,
+        dst_node_areas: set[NodeAndArea],
+        per_destination: bool,
+        shortest: float,
+        nexthop_nodes: dict[tuple[str, str], float],
+    ) -> float:
+        """One area's contribution to getNextHopsWithMetric, answered from
+        the fleet product instead of a per-source SPF.
+
+        Stores dist(nh -> dst) under each qualifying (nh, dst_ref) key —
+        provably the value the host path stores (shortest - dist(me, nh))
+        for every qualifying pair, see fleet.py module doc — so the
+        unchanged _get_next_hops equality test
+        (metric(link) + value == min_metric, Decision.cpp:1296-1300)
+        selects identical links on either path."""
+        me = self.my_node_name
+        inf32 = FLEET_INF
+        # min over reachable destinations (mirrors _get_min_cost_nodes)
+        min_metric = float("inf")
+        min_cost_nodes: set[str] = set()
+        for dst_node, _area in dst_node_areas:
+            if not view.covers(dst_node):
+                continue
+            d = view.dist(me, dst_node)
+            if d >= inf32:
+                continue
+            if min_metric >= d:
+                if min_metric > d:
+                    min_metric = d
+                    min_cost_nodes = set()
+                min_cost_nodes.add(dst_node)
+        if shortest < min_metric:
+            return shortest
+        if shortest > min_metric:
+            shortest = min_metric
+            nexthop_nodes.clear()
+        for dst_node in min_cost_nodes:
+            dst_ref = dst_node if per_destination else ""
+            d_me = view.dist(me, dst_node)
+            for link in link_state.links_from_node(me):
+                if not link.is_up():
+                    continue
+                u = link.other_node_name(me)
+                if not view.covers(u):
+                    continue
+                d_u = view.dist(u, dst_node)
+                if d_u >= inf32:
+                    continue
+                # drain: overloaded neighbor only as the destination
+                # itself (the d == 0 source exception of the kernels)
+                if view.is_overloaded_id(u) and d_u != 0:
+                    continue
+                if link.metric_from_node(me) + d_u != d_me:
+                    continue
+                key = (u, dst_ref)
+                prev = nexthop_nodes.get(key)
+                if prev is None or d_u < prev:
+                    nexthop_nodes[key] = d_u
+        return shortest
 
     def _get_next_hops(
         self,
@@ -1074,16 +1188,28 @@ class SpfSolver:
         area_link_states: dict[str, LinkState],
         prefix_state: PrefixState,
         my_node_name: Optional[str] = None,
+        fleet_views: Optional[dict[str, FleetRouteView]] = None,
     ) -> Optional[DecisionRouteDb]:
         """Reference: buildRouteDb (Decision.cpp:615-793).  Source-
         parameterized: `my_node_name` may be any node (the axis the TPU
-        backend batches over; see OpenrCtrlHandler getRouteDbComputed)."""
+        backend batches over; see OpenrCtrlHandler getRouteDbComputed).
+
+        With `fleet_views` (area -> FleetRouteView), SP_ECMP reachability
+        and next-hop selection are answered from the reduced all-sources
+        product instead of per-source SPF — the daemon consumer of
+        ops.allsources (KSP2 prefixes still go through the per-source
+        path machinery; the views don't carry per-destination masked
+        re-runs)."""
         me = my_node_name or self.my_node_name
         if not any(ls.has_node(me) for ls in area_link_states.values()):
             return None
         self._bump("decision.route_build_runs")
 
         prev_me, self.my_node_name = self.my_node_name, me
+        prev_fleet, self._fleet_views = (
+            self._fleet_views,
+            fleet_views or {},
+        )
         try:
             route_db = DecisionRouteDb()
             self.best_routes_cache.clear()
@@ -1135,6 +1261,122 @@ class SpfSolver:
             return route_db
         finally:
             self.my_node_name = prev_me
+            self._fleet_views = prev_fleet
+
+    def _build_fleet_views(
+        self,
+        area_link_states: dict[str, LinkState],
+        prefix_state: PrefixState,
+        explicit: bool,
+    ) -> dict[str, FleetRouteView]:
+        """Per-area fleet views.  `explicit` (operator asked for the fleet
+        product by name) always computes; otherwise a cold view is only
+        computed when the measured dispatch policy says the device round
+        beats per-source work (DeviceSpfBackend docstring) — host backends
+        never compute one implicitly."""
+        views: dict[str, FleetRouteView] = {}
+        mirror = getattr(self.spf, "csr_mirror", None)
+        min_nodes = getattr(self.spf, "min_device_nodes", None)
+        min_sources = getattr(self.spf, "min_device_sources", None)
+        for area, ls in area_link_states.items():
+            dests = fleet_destinations(ls, prefix_state)
+            if not dests:
+                continue
+            if not explicit and not self.fleet.is_warm(ls, dests):
+                if min_nodes is None or ls.num_nodes() < min_nodes:
+                    continue
+                if min_sources is not None and len(dests) < min_sources:
+                    continue
+            view = self.fleet.view(
+                ls, dests, csr=mirror(ls) if mirror is not None else None
+            )
+            if view is not None:
+                views[area] = view
+        return views
+
+    def any_node_route_db(
+        self,
+        area_link_states: dict[str, LinkState],
+        prefix_state: PrefixState,
+        node: str,
+    ) -> Optional[DecisionRouteDb]:
+        """Any-node ctrl query (reference: getDecisionRouteDb,
+        Decision.cpp:1510-1530), served from the fleet product when the
+        per-area view is warm (zero device work) or worth computing under
+        the measured dispatch policy; per-source path otherwise."""
+        views = self._build_fleet_views(
+            area_link_states, prefix_state, explicit=False
+        )
+        # the build touches the queried router and its neighbors: fetch
+        # those distance columns in ONE device gather per area instead of
+        # one taxed dispatch each
+        for area, view in views.items():
+            if not view.covers(node):
+                continue
+            ls = area_link_states[area]
+            wanted = {node}
+            for link in ls.links_from_node(node):
+                wanted.add(link.other_node_name(node))
+            view.prefetch_cols(sorted(wanted))
+        return self.build_route_db(
+            area_link_states,
+            prefix_state,
+            my_node_name=node,
+            fleet_views=views,
+        )
+
+    def fleet_route_dbs(
+        self,
+        area_link_states: dict[str, LinkState],
+        prefix_state: PrefixState,
+        nodes: Optional[list[str]] = None,
+    ) -> dict[str, DecisionRouteDb]:
+        """Fleet-wide route dump: ONE reverse-SSSP device round per area
+        answers every requested router's route build (default: every node).
+        This is the daemon consumer of the reduced all-sources product —
+        the reference's equivalent is N sequential buildRouteDb calls
+        (Decision.cpp:615-793) over the per-source SPF memo.
+
+        Views are cached per (LinkState version, destination set), so a
+        warm cache serves any-node ctrl queries with zero device work.
+        This is the operator's EXPLICIT fleet request: views are computed
+        regardless of backend (a cold compute at scale runs a P-source
+        device round — and, first time, its XLA compile — on the calling
+        thread; the implicit any-node path applies the dispatch policy
+        instead, see _build_fleet_views)."""
+        views = self._build_fleet_views(
+            area_link_states, prefix_state, explicit=True
+        )
+        if nodes is None:
+            nodes = sorted(
+                {
+                    n
+                    for ls in area_link_states.values()
+                    for n in ls.node_names
+                }
+            )
+        # queries touch each router and its neighbors: fetch the distance
+        # columns for the whole dump in one device gather per area
+        for area, view in views.items():
+            ls = area_link_states[area]
+            wanted = set()
+            for n in nodes:
+                if not view.covers(n):
+                    continue
+                wanted.add(n)
+                for link in ls.links_from_node(n):
+                    wanted.add(link.other_node_name(n))
+            view.prefetch_cols(sorted(wanted))
+        out: dict[str, DecisionRouteDb] = {}
+        for node in nodes:
+            db = self.build_route_db(
+                area_link_states,
+                prefix_state,
+                my_node_name=node,
+                fleet_views=views,
+            )
+            out[node] = db if db is not None else DecisionRouteDb()
+        return out
 
     def _build_node_label_routes(
         self,

@@ -160,6 +160,7 @@ def reduced_all_sources(
     edge_up,
     node_overloaded,
     n_sweeps: Optional[int] = None,
+    fused: bool = True,
 ):
     """Fleet-wide route-building input in one device round:
     (dist [P, N*] int32 jax — dist[p, v] = dist(v -> p), nh_bitmap
@@ -169,25 +170,140 @@ def reduced_all_sources(
     arrays (benchmarks.synthetic.reversed_topology / csr mirror).  With
     `n_sweeps` the call is non-adaptive (bench timing; caller asserts
     convergence).  Adaptive mode doubles the runner's hint on a False
-    verdict without re-running converged work — the distances of the
-    converged attempt feed the bitmap pass directly."""
+    verdict — then REFINES the hint back down by bounded binary probes,
+    exactly like SpfRunner.forward: a doubling overshoot would otherwise
+    tax every later product round with up to 2x surplus supersweeps.
+
+    With `fused` (default) the relax and the bitmap pass run in ONE
+    device program (_fused_product): through a latency-bound transport
+    the second dispatch costs a full flat fee, which round-4 measured at
+    ~100-200 ms in degraded windows — as large as the entire in-dispatch
+    work."""
     import numpy as _np
 
     dest_ids = jnp.asarray(_np.asarray(dest_ids, dtype=_np.int32))
-    while True:
-        sweeps = n_sweeps if n_sweeps is not None else reverse_runner.hint
+
+    def run(sweeps: int, want_bitmap: bool):
+        # the one-program fusion exists on the banded path only; the ELL
+        # fallback computes the bitmap separately AFTER convergence, so
+        # failed adaptive attempts never pay a discarded bitmap pass
+        if want_bitmap and fused and reverse_runner.bg is not None:
+            return _fused_product(
+                dest_ids,
+                reverse_runner,
+                out,
+                edge_metric,
+                edge_up,
+                node_overloaded,
+                sweeps,
+            )
         dist, _, ok = reverse_runner.run_once(
             dest_ids, sweeps, want_dag=False
         )
-        if n_sweeps is not None or bool(ok):
-            break
-        if reverse_runner.small_dist and reverse_runner.hint >= 32:
-            # same uint16-saturation fallback as SpfRunner.forward
-            # (keyed on the effective mode of the failed run)
-            reverse_runner.small_allowed = False
-        else:
-            reverse_runner.hint = sweeps * 2
+        return dist, None, ok
+
+    if n_sweeps is not None:
+        dist, bitmap, ok = run(n_sweeps, want_bitmap=True)
+    else:
+        # shared adaptation machinery (double / saturation-fallback /
+        # capped refine-down): SpfRunner.adapt
+        def attempt(sweeps: int):
+            r = run(sweeps, want_bitmap=True)
+            return r, bool(r[2])
+
+        dist, bitmap, ok = reverse_runner.adapt(
+            "hint",
+            attempt=attempt,
+            probe=lambda s: bool(run(s, want_bitmap=False)[2]),
+            eff_small=lambda: reverse_runner.small_dist,
+        )
+    if bitmap is None:
+        bitmap = ecmp_bitmap_from_reverse_dist(
+            dist, out, edge_metric, edge_up, node_overloaded, out.n_words
+        )
+    return dist, bitmap, ok
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "n_supersweeps",
+        "depth",
+        "resid_rounds",
+        "small_dist",
+        "n_words",
+    ),
+)
+def _fused_product_banded(
+    dest_ids,
+    bg,
+    r_edge_src,
+    r_edge_dst,
+    r_edge_metric,
+    r_edge_up,
+    node_overloaded,
+    out: OutEll,
+    f_edge_metric,
+    f_edge_up,
+    n_supersweeps: int,
+    depth: int,
+    resid_rounds: int,
+    small_dist: bool,
+    n_words: int,
+):
+    """Reverse relax + fleet ECMP bitmaps as ONE compiled program (banded
+    path).  Bitmaps are computed unconditionally; on a failed convergence
+    verdict the caller re-runs, wasting only the cheap bitmap pass."""
+    from .banded import spf_forward_banded
+
+    # spf_forward_banded returns dist [S, N] == the [P, N*] drev layout
+    dist, _, ok = spf_forward_banded(
+        dest_ids,
+        bg,
+        r_edge_src,
+        r_edge_dst,
+        r_edge_metric,
+        r_edge_up,
+        node_overloaded,
+        n_supersweeps=n_supersweeps,
+        depth=depth,
+        resid_rounds=resid_rounds,
+        small_dist=small_dist,
+        want_dag=False,
+    )
     bitmap = ecmp_bitmap_from_reverse_dist(
-        dist, out, edge_metric, edge_up, node_overloaded, out.n_words
+        dist, out, f_edge_metric, f_edge_up, node_overloaded, n_words
     )
     return dist, bitmap, ok
+
+
+def _fused_product(
+    dest_ids,
+    reverse_runner,
+    out: OutEll,
+    f_edge_metric,
+    f_edge_up,
+    node_overloaded,
+    n_sweeps: int,
+):
+    """One-dispatch reduced product (banded path only; callers fall back
+    to run_once + a post-convergence bitmap pass on ELL topologies)."""
+    assert reverse_runner.bg is not None
+    r_src, r_dst, r_metric, r_up, r_ov = reverse_runner.call_arrays()
+    return _fused_product_banded(
+        dest_ids,
+        reverse_runner.bg,
+        r_src,
+        r_dst,
+        r_metric,
+        r_up,
+        r_ov,
+        out,
+        jnp.asarray(f_edge_metric),
+        jnp.asarray(f_edge_up),
+        n_supersweeps=n_sweeps,
+        depth=reverse_runner.depth,
+        resid_rounds=reverse_runner.resid_rounds,
+        small_dist=reverse_runner.small_dist,
+        n_words=out.n_words,
+    )

@@ -497,12 +497,76 @@ class SpfRunner:
         # edge_metric IN PLACE (csr.refresh) and an oversized metric must
         # never reach the uint16 kernel (it would be masked as down)
         self.small_allowed = bg is not None
+        # optional device-resident pin of the runtime arrays (stage())
+        self._staged = None
 
     @property
     def small_dist(self) -> bool:
         return self.small_allowed and pick_small_dist(
             self.arrays[2], self.n_edges
         )
+
+    def stage(self) -> None:
+        """Pin the runtime arrays as device-resident buffers: every
+        run_once with host numpy arrays re-uploads ~MBs of edge state
+        per dispatch, which through a latency-bound transport is pure
+        wall time.  EXPLICIT opt-in — `self.arrays` (numpy) stays the
+        source of truth, and any caller that mutates those arrays in
+        place afterwards (csr.refresh attribute updates, tests flipping
+        edge_up) must unstage() or re-stage(), or dispatches read stale
+        state."""
+        self._staged = tuple(jnp.asarray(a) for a in self.arrays)
+
+    def unstage(self) -> None:
+        self._staged = None
+
+    def call_arrays(self):
+        """Arrays to feed a dispatch: the staged device buffers when
+        pinned, else the numpy source (uploaded per call)."""
+        return self._staged if self._staged is not None else self.arrays
+
+    def adapt(self, hint_attr: str, attempt, probe, eff_small):
+        """THE fixed-sweep adaptation loop, shared by every consumer
+        (forward, ops.allsources.reduced_all_sources, ops.ksp): run
+        `attempt(sweeps)` at the learned hint, double on a failed
+        convergence verdict — after two doublings under the effective
+        uint16 mode, latch small_allowed off instead (the saturation
+        guard also presents as non-convergence) — then refine the hint
+        back DOWN with `probe(mid)` binary steps.
+
+        Refine-down is capped at 2 probes: doubling overshoots by up to
+        2x and every later production dispatch would pay the surplus
+        sweeps forever, but each distinct sweep count is a fresh XLA
+        compile (~tens of seconds at 100k), so land within ~12% of
+        minimal and stop.
+
+        attempt(sweeps) -> (result, ok); probe(sweeps) -> ok (a cheaper
+        call whose result is discarded); eff_small() -> the effective
+        uint16 mode of the run that just failed (keyed on the metric
+        plane actually used — an int32 run must double instead of
+        repeating the identical dispatch)."""
+        doubled_from: Optional[int] = None
+        while True:
+            sweeps = getattr(self, hint_attr)
+            result, ok = attempt(sweeps)
+            if ok:
+                if doubled_from is not None:
+                    lo, hi = doubled_from, sweeps
+                    probes = 0
+                    while hi - lo > 1 and probes < 2:
+                        probes += 1
+                        mid = (lo + hi) // 2
+                        if probe(mid):
+                            hi = mid
+                        else:
+                            lo = mid
+                    setattr(self, hint_attr, hi)
+                return result
+            if eff_small() and sweeps >= 32:
+                self.small_allowed = False
+            else:
+                doubled_from = sweeps
+                setattr(self, hint_attr, sweeps * 2)
 
     def forward(
         self,
@@ -515,26 +579,40 @@ class SpfRunner:
     ):
         """(dist np [S, N*], dag np|None).  With `n_sweeps`, runs exactly
         one fixed-sweep call (caller owns the hint — bench timing);
-        otherwise doubles the learned hint until converged.
+        otherwise adapts the learned hint through `adapt`.
         `metric_plane` substitutes an alternate [E_cap] metric array
         (e.g. a TE cost plane) for this call — same graph, different
         costs, no table rebuild (BASELINE config #3 dual-metric KSP)."""
         import numpy as _np
 
         sources = jnp.asarray(_np.asarray(sources, dtype=_np.int32))
-        hint_attr = "hint" if extra_edge_mask is None else "hint_masked"
-        doubled_from: Optional[int] = None
-        while True:
-            sweeps = (
-                n_sweeps if n_sweeps is not None else getattr(self, hint_attr)
+        if n_sweeps is not None:
+            dist, dag, ok = self.run_once(
+                sources,
+                n_sweeps,
+                use_link_metric=use_link_metric,
+                extra_edge_mask=extra_edge_mask,
+                want_dag=want_dag,
+                metric_plane=metric_plane,
             )
-            # the EFFECTIVE uint16 mode of this run — gated on the
-            # metric plane actually used, exactly as run_once gates it
-            eff_small = self.small_allowed and pick_small_dist(
+            if not bool(ok):
+                raise RuntimeError(
+                    f"fixed {n_sweeps}-sweep run did not converge"
+                )
+            return (
+                _np.asarray(dist),
+                None if dag is None else _np.asarray(dag),
+            )
+        hint_attr = "hint" if extra_edge_mask is None else "hint_masked"
+
+        def eff_small() -> bool:
+            return self.small_allowed and pick_small_dist(
                 metric_plane if metric_plane is not None else self.arrays[2],
                 self.n_edges,
             )
-            dist, dag, ok = self.run_once(
+
+        def attempt(sweeps: int):
+            out = self.run_once(
                 sources,
                 sweeps,
                 use_link_metric=use_link_metric,
@@ -542,51 +620,20 @@ class SpfRunner:
                 want_dag=want_dag,
                 metric_plane=metric_plane,
             )
-            if bool(ok):
-                if doubled_from is not None and n_sweeps is None:
-                    # refine DOWN: doubling overshoots by up to 2x, and
-                    # every production dispatch pays the surplus sweeps
-                    # forever.  A short binary search between the failed
-                    # and the successful count lands the minimal hint
-                    # (one-time probe dispatches; results discarded).
-                    # capped at 2 probes: each distinct sweep count is a
-                    # fresh XLA compile (~tens of seconds at 100k), so
-                    # land within ~12% of minimal and stop
-                    lo, hi = doubled_from, sweeps
-                    probes = 0
-                    while hi - lo > 1 and probes < 2:
-                        probes += 1
-                        mid = (lo + hi) // 2
-                        _, _, mid_ok = self.run_once(
-                            sources,
-                            mid,
-                            use_link_metric=use_link_metric,
-                            extra_edge_mask=extra_edge_mask,
-                            want_dag=False,
-                            metric_plane=metric_plane,
-                        )
-                        if bool(mid_ok):
-                            hi = mid
-                        else:
-                            lo = mid
-                    setattr(self, hint_attr, hi)
-                break
-            if n_sweeps is not None:
-                raise RuntimeError(
-                    f"fixed {sweeps}-sweep run did not converge"
-                )
-            if eff_small and getattr(self, hint_attr) >= 32:
-                # saturation guard can also fail convergence; after two
-                # doublings under uint16, retry in int32 before doubling
-                # further.  Keyed on the failed run's effective mode —
-                # an int32 run must double instead of repeating the
-                # identical dispatch, and a uint16 metric-plane run must
-                # be able to take this branch even when the base plane
-                # is int32-gated.
-                self.small_allowed = False
-            else:
-                doubled_from = sweeps
-                setattr(self, hint_attr, sweeps * 2)
+            return out, bool(out[2])
+
+        def probe(sweeps: int) -> bool:
+            _, _, mid_ok = self.run_once(
+                sources,
+                sweeps,
+                use_link_metric=use_link_metric,
+                extra_edge_mask=extra_edge_mask,
+                want_dag=False,
+                metric_plane=metric_plane,
+            )
+            return bool(mid_ok)
+
+        dist, dag, _ = self.adapt(hint_attr, attempt, probe, eff_small)
         return (
             _np.asarray(dist),
             None if dag is None else _np.asarray(dag),
@@ -604,12 +651,16 @@ class SpfRunner:
         """One fixed-sweep device call; returns jax (dist, dag, ok)."""
         from .sssp import spf_forward_ell_sweeps
 
-        edge_src, edge_dst, edge_metric, edge_up, node_overloaded = self.arrays
+        edge_src, edge_dst, edge_metric, edge_up, node_overloaded = (
+            self.call_arrays()
+        )
         if metric_plane is not None:
             edge_metric = metric_plane
-        # gate uint16 on the EFFECTIVE metric plane for this call
+        # gate uint16 on the EFFECTIVE metric plane for this call (from
+        # the numpy source of truth — never a device fetch)
         small = self.small_allowed and pick_small_dist(
-            edge_metric, self.n_edges
+            metric_plane if metric_plane is not None else self.arrays[2],
+            self.n_edges,
         )
         if self.bg is not None:
             return spf_forward_banded(

@@ -89,6 +89,10 @@ class TestWatchdog:
         watchdog = Watchdog(
             interval_s=0.05,
             thread_timeout_s=0.2,
+            # this test is about STALLS: the default 800MB RSS limit can
+            # fire first when the suite's jax compilations grow the
+            # shared pytest process past it (observed flake)
+            max_memory_bytes=1 << 40,
             on_crash=fired.append,
         )
         evb = OpenrEventBase(name="victim")

@@ -283,6 +283,12 @@ class TestTcpSystem:
         assert "adj:tcp-0" in out and "prefix:[tcp-1]" in out
         out = self.breeze(ports[0], "decision", "routes")
         assert "fc01::/64" in out
+        # any-node query (fleet-product path when warm) and the
+        # fleet-wide dump RPC (getFleetRoutes over ops.allsources)
+        out = self.breeze(ports[0], "decision", "routes", "--node", "tcp-1")
+        assert "Unicast Routes" in out
+        out = self.breeze(ports[0], "decision", "fleet-routes")
+        assert "tcp-0" in out and "tcp-1" in out and "fc01::/64" in out
         out = self.breeze(ports[0], "decision", "adj")
         assert "tcp-0" in out and "tcp-1" in out
         out = self.breeze(ports[0], "fib", "routes")
