@@ -53,6 +53,12 @@ class Topology:
                 self.node_overloaded,
                 self.n_edges,
             )
+            # pin the runtime arrays device-resident: per-dispatch numpy
+            # re-upload of ~11MB edge state measured ~130ms of pure wall
+            # through the tunnel (round-5 tune).  Callers that mutate the
+            # arrays in place AFTER this point must call runner.stage()
+            # again (tests mutate before first runner access)
+            self._runner.stage()
         return self._runner
 
     @classmethod

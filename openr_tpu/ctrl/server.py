@@ -52,8 +52,10 @@ class OpenrCtrlHandler:
         config=None,
         kvstore_updates_queue: Optional[ReplicateQueue[Publication]] = None,
         fib_updates_queue: Optional[ReplicateQueue] = None,
+        config_store=None,
     ) -> None:
         self.node_name = node_name
+        self.config_store = config_store
         self.kvstore = kvstore
         self.decision = decision
         self.fib = fib
@@ -92,6 +94,23 @@ class OpenrCtrlHandler:
             for k, v in self._all_counters().items()
             if re.search(p["regex"], k)
         }
+        m["getBuildInfo"] = lambda p: {
+            "buildPackageName": "openr_tpu",
+            "buildPackageVersion": OPENR_VERSION,
+            "buildMode": "tpu",
+        }
+
+        # -- persistent config store (reference: set/get/eraseConfigKey,
+        #    OpenrCtrlHandler.h:60-67 over PersistentStore)
+        m["setConfigKey"] = lambda p: self._need(
+            self.config_store, "config-store"
+        ).store(p["key"], p["value"])
+        m["getConfigKey"] = lambda p: self._need(
+            self.config_store, "config-store"
+        ).load(p["key"])
+        m["eraseConfigKey"] = lambda p: self._need(
+            self.config_store, "config-store"
+        ).erase(p["key"])
 
         # -- kvstore ----------------------------------------------------------
         m["getKvStoreKeyValsArea"] = lambda p: self._need(
@@ -220,6 +239,28 @@ class OpenrCtrlHandler:
 
         # -- spark ------------------------------------------------------------
         m["getSparkNeighbors"] = self._spark_neighbors
+        m["getNeighbors"] = self._spark_neighbors  # deprecated ref alias
+        # announce our own graceful restart to all neighbors (reference:
+        # floodRestartingMsg, OpenrCtrlHandler.h / Spark.h:99)
+        m["floodRestartingMsg"] = lambda p: self._need(
+            self.spark, "spark"
+        ).flood_restarting_msg()
+
+        # -- deprecated area-less reference names: every area-taking
+        # handler above defaults to area "0", so these are pure aliases
+        # (the reference kept both during its area migration,
+        # OpenrCtrlHandler.h getKvStoreKeyVals vs ...Area etc.)
+        m["getKvStoreKeyVals"] = m["getKvStoreKeyValsArea"]
+        m["getKvStoreKeyValsFiltered"] = m["getKvStoreKeyValsFilteredArea"]
+        m["getKvStoreHashFiltered"] = m["getKvStoreHashFilteredArea"]
+        m["getKvStorePeers"] = m["getKvStorePeersArea"]
+        m["getLinkMonitorAdjacencies"] = m["getLinkMonitorAdjacenciesFiltered"]
+        m["getReceivedRoutes"] = m["getReceivedRoutesFiltered"]
+        m["getUnicastRoutes"] = m["getUnicastRoutesFiltered"]
+        m["getDecisionAdjacencyDbs"] = m["getDecisionAdjacenciesFiltered"]
+        m["getAdvertisedRoutes"] = self._advertised_routes
+        m["getAdvertisedRoutesFiltered"] = self._advertised_routes
+        m["getRouteDetailDb"] = self._route_detail_db
 
     # -- non-lambda handlers --------------------------------------------------
 
@@ -332,6 +373,44 @@ class OpenrCtrlHandler:
             programmed_only=bool(p.get("programmedOnly"))
         )
         return {"unicastRoutes": unicast, "mplsRoutes": mpls}
+
+    def _advertised_routes(self, p: dict) -> list[dict]:
+        """Per-prefix advertisement detail from PrefixManager (reference:
+        getAdvertisedRoutesFiltered, OpenrCtrlHandler.h:129-140 — one row
+        per prefix with every per-type entry; filterable by prefixes)."""
+        pm = self._need(self.prefix_manager, "prefix-manager")
+        from ..types import PrefixType, normalize_prefix
+
+        wanted = (
+            {normalize_prefix(x) for x in p["prefixes"]}
+            if p.get("prefixes")
+            else None
+        )
+        by_prefix: dict[str, list[tuple[int, Any]]] = {}
+        for ptype in PrefixType:
+            for entry in pm.get_prefixes(ptype):
+                prefix = normalize_prefix(entry.prefix)
+                if wanted is not None and prefix not in wanted:
+                    continue
+                by_prefix.setdefault(prefix, []).append(
+                    (int(ptype), entry)
+                )
+        return [
+            {"prefix": prefix, "routes": rows}
+            for prefix, rows in sorted(by_prefix.items())
+        ]
+
+    def _route_detail_db(self, p: dict) -> dict:
+        """Computed unicast/MPLS entries WITH their best-prefix-entry
+        detail (reference: getRouteDetailDb, OpenrCtrlHandler.h:98 —
+        the Fib view annotated with route provenance).  Served from
+        Decision's RibEntries, which carry best_prefix_entry/best_area."""
+        decision = self._need(self.decision, "decision")
+        db = decision.get_route_db()
+        return {
+            "unicast_routes": db.unicast_routes,
+            "mpls_routes": db.mpls_routes,
+        }
 
     def _spark_neighbors(self, p: dict) -> list[dict]:
         spark = self._need(self.spark, "spark")
@@ -454,17 +533,27 @@ class CtrlServer(OpenrEventBase):
                         {"id": msg_id, "error": f"bad params: {e}"}
                     )
                     continue
-                if method == "subscribeKvStore":
+                # reference stream names accepted as aliases
+                # (subscribeAndGetKvStore[Filtered] / subscribeAndGetFib,
+                # OpenrCtrlHandler.h:240-267)
+                if method in (
+                    "subscribeKvStore",
+                    "subscribeAndGetKvStore",
+                    "subscribeAndGetKvStoreFiltered",
+                ):
                     streams[msg_id] = asyncio.ensure_future(
                         self._stream_kvstore(msg_id, params, send)
                     )
                     self._track(streams[msg_id])
-                elif method == "subscribeFib":
+                elif method in ("subscribeFib", "subscribeAndGetFib"):
                     streams[msg_id] = asyncio.ensure_future(
                         self._stream_fib(msg_id, params, send)
                     )
                     self._track(streams[msg_id])
-                elif method == "longPollKvStoreAdjArea":
+                elif method in (
+                    "longPollKvStoreAdjArea",
+                    "longPollKvStoreAdj",
+                ):
                     streams[msg_id] = asyncio.ensure_future(
                         self._long_poll_adj(msg_id, params, send)
                     )

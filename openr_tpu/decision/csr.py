@@ -111,6 +111,8 @@ class CsrTopology:
                 self.node_overloaded,
                 self.n_edges,
             )
+            # device-pin the runtime arrays (re-staged by refresh())
+            self._runner.stage()
         return self._runner
 
     # -- construction -------------------------------------------------------
@@ -250,8 +252,10 @@ class CsrTopology:
             self.node_overloaded[i] = ls.is_node_overloaded(name)
         self.version = ls.version
         if self._runner is not None:
-            # a staged (device-pinned) runner would read pre-refresh state
-            self._runner.unstage()
+            # re-pin the refreshed values (a stale staged runner would
+            # read pre-refresh state); one upload per topology change,
+            # amortized over every later dispatch
+            self._runner.stage()
         return True
 
     # -- SPF execution ------------------------------------------------------

@@ -316,6 +316,7 @@ class OpenrDaemon:
             config=self.config,
             kvstore_updates_queue=self.kvstore_updates_queue,
             fib_updates_queue=self.fib_updates_queue,
+            config_store=self.config_store,
         )
         self.ctrl_server = CtrlServer(
             handler,

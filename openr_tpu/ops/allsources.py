@@ -160,7 +160,7 @@ def reduced_all_sources(
     edge_up,
     node_overloaded,
     n_sweeps: Optional[int] = None,
-    fused: bool = True,
+    fused: bool = False,
 ):
     """Fleet-wide route-building input in one device round:
     (dist [P, N*] int32 jax — dist[p, v] = dist(v -> p), nh_bitmap
@@ -174,11 +174,14 @@ def reduced_all_sources(
     exactly like SpfRunner.forward: a doubling overshoot would otherwise
     tax every later product round with up to 2x surplus supersweeps.
 
-    With `fused` (default) the relax and the bitmap pass run in ONE
-    device program (_fused_product): through a latency-bound transport
-    the second dispatch costs a full flat fee, which round-4 measured at
-    ~100-200 ms in degraded windows — as large as the entire in-dispatch
-    work."""
+    `fused` compiles the relax and the bitmap pass into ONE device
+    program (_fused_product), saving a dispatch fee.  It is OFF by
+    default on measurement: the round-5 tune clocked the fused program
+    ~100 ms SLOWER in-dispatch at wan100k/P=1024 (XLA schedules the
+    combined program worse) while the second dispatch of the unfused
+    path overlaps the relax and costs ~30 ms marginal — so fusion only
+    pays when the transport's flat per-dispatch fee is in its degraded
+    (~100-400 ms) window."""
     import numpy as _np
 
     dest_ids = jnp.asarray(_np.asarray(dest_ids, dtype=_np.int32))

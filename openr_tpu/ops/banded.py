@@ -473,13 +473,27 @@ class SpfRunner:
         node_overloaded,
         n_edges: int,
         hint: int = 8,
-        depth: int = 2,
+        depth: Optional[int] = None,
         resid_rounds: int = 1,
     ) -> None:
         self.ell = ell
         self.bg = bg
         self.arrays = (edge_src, edge_dst, edge_metric, edge_up, node_overloaded)
         self.n_edges = n_edges
+        if depth is None:
+            # measured (round-5 tune, wan100k P=1024): on chord-rich
+            # small-world graphs the supersweep count is floored by CHORD
+            # hop depth (14 at both depth 1 and 2), so composed band
+            # levels are pure overhead — depth 1 won wall by ~15%.
+            # Band-dominated topologies (grids: long straight runs) still
+            # need the composed levels.
+            if bg is not None and n_edges > 0:
+                resid_frac = float(
+                    (np.asarray(bg.resid_eid) >= 0).sum()
+                ) / float(n_edges)
+                depth = 1 if resid_frac > 0.25 else 2
+            else:
+                depth = 2
         self.depth = depth
         self.resid_rounds = resid_rounds
         self.hint = hint

@@ -294,3 +294,91 @@ class TestCtrlGapRpcs:
             breeze.main(
                 ["-p", str(daemon.ctrl_port), "config", "dryrun", str(bad)]
             )
+
+
+class TestCtrlDeltaRpcs:
+    """Round-5 RPC-delta closure vs the reference handler
+    (OpenrCtrlHandler.h:53-381): persistent-store keys, build info,
+    deprecated area-less aliases, spark GR flood, advertised-route and
+    route-detail views."""
+
+    def test_build_info(self, daemon):
+        client = CtrlClient(port=daemon.ctrl_port)
+        try:
+            info = client.call("getBuildInfo")
+            assert info["buildPackageName"] == "openr_tpu"
+        finally:
+            client.close()
+
+    def test_config_key_roundtrip(self, daemon):
+        client = CtrlClient(port=daemon.ctrl_port)
+        try:
+            client.call("setConfigKey", key="k1", value=b"\x01\x02")
+            assert client.call("getConfigKey", key="k1") == b"\x01\x02"
+            assert client.call("eraseConfigKey", key="k1") is True
+            assert client.call("getConfigKey", key="k1") is None
+            assert client.call("eraseConfigKey", key="k1") is False
+        finally:
+            client.close()
+
+    def test_area_less_aliases_match_area_variants(self, daemon):
+        client = CtrlClient(port=daemon.ctrl_port)
+        try:
+            assert client.call("getKvStorePeers") == client.call(
+                "getKvStorePeersArea", area="0"
+            )
+            a = client.call("getKvStoreKeyVals", keys=[])
+            b = client.call("getKvStoreKeyValsArea", area="0", keys=[])
+            assert type(a) is type(b)
+            assert client.call("getNeighbors") == client.call(
+                "getSparkNeighbors"
+            )
+            assert client.call("getDecisionAdjacencyDbs") == client.call(
+                "getDecisionAdjacenciesFiltered"
+            )
+        finally:
+            client.close()
+
+    def test_advertised_routes(self, daemon):
+        from openr_tpu.types import PrefixEntry, PrefixType
+
+        client = CtrlClient(port=daemon.ctrl_port)
+        try:
+            client.call(
+                "advertisePrefixes",
+                type=PrefixType.BREEZE,
+                prefixes=[
+                    PrefixEntry(prefix="fc61::/64", type=PrefixType.BREEZE)
+                ],
+            )
+            rows = client.call("getAdvertisedRoutes")
+            assert any(r["prefix"] == "fc61::/64" for r in rows)
+            only = client.call(
+                "getAdvertisedRoutesFiltered", prefixes=["fc61::/64"]
+            )
+            assert len(only) == 1 and only[0]["prefix"] == "fc61::/64"
+            types = [t for t, _e in only[0]["routes"]]
+            assert int(PrefixType.BREEZE) in types
+            assert (
+                client.call(
+                    "getAdvertisedRoutesFiltered", prefixes=["fc62::/64"]
+                )
+                == []
+            )
+        finally:
+            client.close()
+
+    def test_route_detail_db(self, daemon):
+        client = CtrlClient(port=daemon.ctrl_port)
+        try:
+            detail = client.call("getRouteDetailDb")
+            assert set(detail) == {"unicast_routes", "mpls_routes"}
+        finally:
+            client.close()
+
+    def test_flood_restarting_msg(self, daemon):
+        client = CtrlClient(port=daemon.ctrl_port)
+        try:
+            client.call("floodRestartingMsg")  # no neighbors: no-op send
+        finally:
+            client.close()

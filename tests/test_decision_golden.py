@@ -815,3 +815,550 @@ class TestOrderedFibHolds:
         assert nh_names(route) == {"2", "3"}
         by_nh = {nh.neighbor_node_name: nh.metric for nh in route.nexthops}
         assert by_nh["3"] < by_nh["2"]
+
+
+# ---------------------------------------------------------------------------
+# Round-5 tranche: RibPolicy interactions, static-overlay edges, label-range
+# edges, multi-event sequences (flap storms, churn during holds).
+# ---------------------------------------------------------------------------
+
+from openr_tpu.decision.rib_policy import (  # noqa: E402
+    PolicyError,
+    RibPolicy,
+    RibPolicyConfig,
+    RibPolicyStatementConfig,
+    RibRouteActionWeight,
+)
+
+
+def policy(*statements, ttl_secs: int = 60) -> RibPolicy:
+    return RibPolicy(
+        RibPolicyConfig(statements=list(statements), ttl_secs=ttl_secs)
+    )
+
+
+def weights_by_neighbor(route) -> dict:
+    return {nh.neighbor_node_name: nh.weight for nh in route.nexthops}
+
+
+class TestRibPolicyInteractions:
+    """Ancestors: DecisionTestFixture.RibPolicy / RibPolicyError
+    (DecisionTest.cpp:5644-5776) + RibPolicyTest.cpp — applied here to
+    route DBs computed by BOTH backends (the policy transform must see
+    identical inputs either way)."""
+
+    def test_area_weight_applies_per_area(self):
+        # cross-area ECMP: area-0 arm via 2, area-1 arm via 3
+        ls0 = build_link_state(
+            {"1": [adj("1", "2")], "2": [adj("2", "1")]}, area="0"
+        )
+        ls1 = LinkState("1")
+        for node, adjs in (("1", [adj("1", "3")]), ("3", [adj("3", "1")])):
+            ls1.update_adjacency_database(
+                AdjacencyDatabase(
+                    this_node_name=node, adjacencies=adjs, area="1"
+                )
+            )
+        ps = prefix_state_with(
+            ("2", "0", PrefixEntry(prefix=PFX)),
+            ("3", "1", PrefixEntry(prefix=PFX)),
+        )
+        db = routes("1", {"0": ls0, "1": ls1}, ps)
+        route = db.unicast_routes[PFX]
+        assert {nh.area for nh in route.nexthops} == {"0", "1"}
+        pol = policy(
+            RibPolicyStatementConfig(
+                name="area-w",
+                prefixes=[PFX],
+                set_weight=RibRouteActionWeight(
+                    default_weight=1, area_to_weight={"0": 7, "1": 3}
+                ),
+            )
+        )
+        change = pol.apply_policy(db.unicast_routes)
+        assert change.updated_routes == [PFX]
+        by_area = {nh.area: nh.weight for nh in route.nexthops}
+        assert by_area == {"0": 7, "1": 3}
+
+    def test_neighbor_weight_overrides_area(self):
+        ls = square()
+        ps = prefix_state_with(("4", "0", PrefixEntry(prefix=PFX)))
+        db = routes("1", {"0": ls}, ps)
+        route = db.unicast_routes[PFX]
+        pol = policy(
+            RibPolicyStatementConfig(
+                name="nb-w",
+                prefixes=[PFX],
+                set_weight=RibRouteActionWeight(
+                    default_weight=1,
+                    area_to_weight={"0": 5},
+                    neighbor_to_weight={"2": 9},
+                ),
+            )
+        )
+        assert pol.apply_policy(db.unicast_routes).updated_routes == [PFX]
+        assert weights_by_neighbor(route) == {"2": 9, "3": 5}
+
+    def test_zero_weight_drops_nexthop(self):
+        ls = square()
+        ps = prefix_state_with(("4", "0", PrefixEntry(prefix=PFX)))
+        db = routes("1", {"0": ls}, ps)
+        route = db.unicast_routes[PFX]
+        pol = policy(
+            RibPolicyStatementConfig(
+                name="drop-2",
+                prefixes=[PFX],
+                set_weight=RibRouteActionWeight(
+                    default_weight=1, neighbor_to_weight={"2": 0}
+                ),
+            )
+        )
+        pol.apply_policy(db.unicast_routes)
+        assert nh_names(route) == {"3"}
+
+    def test_all_zero_weights_retain_nexthops(self):
+        # RibPolicy.cpp:146-158: never transform a route into a blackhole
+        ls = square()
+        ps = prefix_state_with(("4", "0", PrefixEntry(prefix=PFX)))
+        db = routes("1", {"0": ls}, ps)
+        route = db.unicast_routes[PFX]
+        before = set(route.nexthops)
+        pol = policy(
+            RibPolicyStatementConfig(
+                name="blackhole",
+                prefixes=[PFX],
+                set_weight=RibRouteActionWeight(default_weight=0),
+            )
+        )
+        change = pol.apply_policy(db.unicast_routes)
+        assert change.updated_routes == []
+        assert set(route.nexthops) == before
+
+    def test_tag_matcher_transforms_only_tagged(self):
+        ls = square()
+        ps = prefix_state_with(
+            ("4", "0", PrefixEntry(prefix=PFX, tags=("edge",))),
+            ("4", "0", PrefixEntry(prefix="::2:0/112")),
+        )
+        db = routes("1", {"0": ls}, ps)
+        pol = policy(
+            RibPolicyStatementConfig(
+                name="tagged",
+                tags=["edge"],
+                set_weight=RibRouteActionWeight(default_weight=4),
+            )
+        )
+        change = pol.apply_policy(db.unicast_routes)
+        assert change.updated_routes == [PFX]
+        assert all(
+            nh.weight == 4 for nh in db.unicast_routes[PFX].nexthops
+        )
+        assert all(
+            nh.weight == 0
+            for nh in db.unicast_routes["::2:0/112"].nexthops
+        )
+
+    def test_first_matching_statement_wins(self):
+        ls = square()
+        ps = prefix_state_with(("4", "0", PrefixEntry(prefix=PFX)))
+        db = routes("1", {"0": ls}, ps)
+        pol = policy(
+            RibPolicyStatementConfig(
+                name="first",
+                prefixes=[PFX],
+                set_weight=RibRouteActionWeight(default_weight=2),
+            ),
+            RibPolicyStatementConfig(
+                name="second",
+                prefixes=[PFX],
+                set_weight=RibRouteActionWeight(default_weight=8),
+            ),
+        )
+        pol.apply_policy(db.unicast_routes)
+        assert all(
+            nh.weight == 2 for nh in db.unicast_routes[PFX].nexthops
+        )
+
+    def test_expired_policy_is_noop(self):
+        ls = square()
+        ps = prefix_state_with(("4", "0", PrefixEntry(prefix=PFX)))
+        db = routes("1", {"0": ls}, ps)
+        before = set(db.unicast_routes[PFX].nexthops)
+        pol = policy(
+            RibPolicyStatementConfig(
+                name="expired",
+                prefixes=[PFX],
+                set_weight=RibRouteActionWeight(default_weight=5),
+            ),
+            ttl_secs=0,
+        )
+        assert not pol.is_active()
+        assert pol.apply_policy(db.unicast_routes).updated_routes == []
+        assert set(db.unicast_routes[PFX].nexthops) == before
+
+    def test_policy_requires_statements_and_matcher(self):
+        with pytest.raises(PolicyError):
+            RibPolicy(RibPolicyConfig(statements=[], ttl_secs=10))
+        with pytest.raises(PolicyError):
+            policy(
+                RibPolicyStatementConfig(
+                    name="no-matcher",
+                    set_weight=RibRouteActionWeight(default_weight=1),
+                )
+            )
+        with pytest.raises(PolicyError):
+            policy(RibPolicyStatementConfig(name="no-action", prefixes=[PFX]))
+
+
+class TestStaticOverlayEdges:
+    """Ancestors: static-route handling in buildRouteDb
+    (Decision.cpp:427-449 createRouteForPrefixOrGetStaticRoute,
+    :776-791 static overlays appended last)."""
+
+    @staticmethod
+    def sq_solvers():
+        host = SpfSolver("1")
+        device = SpfSolver(
+            "1",
+            spf_backend=DeviceSpfBackend(
+                min_device_nodes=1, min_device_sources=1
+            ),
+        )
+        return host, device
+
+    @staticmethod
+    def static_nh(addr="fe80::9", metric=0):
+        return NextHop(address=addr, metric=metric)
+
+    def both(self, solver_pair, area_ls, ps):
+        host = solver_pair[0].build_route_db(area_ls, ps)
+        device = solver_pair[1].build_route_db(area_ls, ps)
+        assert host.unicast_routes == device.unicast_routes
+        assert host.mpls_routes == device.mpls_routes
+        return host
+
+    def test_computed_wins_over_static(self):
+        ls = square()
+        ps = prefix_state_with(("4", "0", PrefixEntry(prefix=PFX)))
+        pair = self.sq_solvers()
+        for s in pair:
+            s.update_static_unicast_routes(
+                [UnicastRoute(dest=PFX, next_hops=[self.static_nh()])], []
+            )
+        db = self.both(pair, {"0": ls}, ps)
+        # the computed route's nexthops, not the static one's
+        assert nh_names(db.unicast_routes[PFX]) == {"2", "3"}
+
+    def test_static_surfaces_after_withdrawal(self):
+        ls = square()
+        ps = prefix_state_with(("4", "0", PrefixEntry(prefix=PFX)))
+        pair = self.sq_solvers()
+        for s in pair:
+            s.update_static_unicast_routes(
+                [UnicastRoute(dest=PFX, next_hops=[self.static_nh()])], []
+            )
+        ps.delete_prefix("4", "0", PFX)
+        db = self.both(pair, {"0": ls}, ps)
+        assert {nh.address for nh in db.unicast_routes[PFX].nexthops} == {
+            "fe80::9"
+        }
+
+    def test_static_only_prefix_coexists(self):
+        ls = square()
+        ps = prefix_state_with(("4", "0", PrefixEntry(prefix=PFX)))
+        pair = self.sq_solvers()
+        for s in pair:
+            s.update_static_unicast_routes(
+                [
+                    UnicastRoute(
+                        dest="::5:0/112", next_hops=[self.static_nh()]
+                    )
+                ],
+                [],
+            )
+        db = self.both(pair, {"0": ls}, ps)
+        assert PFX in db.unicast_routes
+        assert "::5:0/112" in db.unicast_routes
+
+    def test_static_mpls_loses_to_node_label(self):
+        ls = square()  # node labels 101..104
+        ps = prefix_state_with(("4", "0", PrefixEntry(prefix=PFX)))
+        pair = self.sq_solvers()
+        for s in pair:
+            s.update_static_mpls_routes(
+                [
+                    MplsRoute(
+                        top_label=102, next_hops=[self.static_nh()]
+                    )
+                ],
+                [],
+            )
+        db = self.both(pair, {"0": ls}, ps)
+        # 102 is node 2's label: the computed label route wins
+        assert all(
+            nh.address != "fe80::9" for nh in db.mpls_routes[102].nexthops
+        )
+
+    def test_static_mpls_unused_label_appears(self):
+        ls = square()
+        ps = prefix_state_with(("4", "0", PrefixEntry(prefix=PFX)))
+        pair = self.sq_solvers()
+        for s in pair:
+            s.update_static_mpls_routes(
+                [
+                    MplsRoute(
+                        top_label=7777, next_hops=[self.static_nh()]
+                    )
+                ],
+                [],
+            )
+        db = self.both(pair, {"0": ls}, ps)
+        assert {nh.address for nh in db.mpls_routes[7777].nexthops} == {
+            "fe80::9"
+        }
+
+    def test_static_update_then_delete(self):
+        ls = square()
+        ps = prefix_state_with(("4", "0", PrefixEntry(prefix=PFX)))
+        pair = self.sq_solvers()
+        for s in pair:
+            s.update_static_unicast_routes(
+                [
+                    UnicastRoute(
+                        dest="::5:0/112", next_hops=[self.static_nh()]
+                    )
+                ],
+                [],
+            )
+            s.update_static_unicast_routes(
+                [
+                    UnicastRoute(
+                        dest="::5:0/112",
+                        next_hops=[self.static_nh(addr="fe80::a")],
+                    )
+                ],
+                [],
+            )
+        db = self.both(pair, {"0": ls}, ps)
+        assert {
+            nh.address for nh in db.unicast_routes["::5:0/112"].nexthops
+        } == {"fe80::a"}
+        for s in pair:
+            s.update_static_unicast_routes([], ["::5:0/112"])
+        db = self.both(pair, {"0": ls}, ps)
+        assert "::5:0/112" not in db.unicast_routes
+
+
+class TestLabelRangeEdges:
+    """Ancestors: MplsRoutes.BasicTest label-validity handling
+    (DecisionTest.cpp:737-780; isMplsLabelValid, openr/common/Util.h) —
+    the 20-bit MPLS label space boundaries."""
+
+    def test_labels_at_range_bounds_valid(self):
+        lo, hi = 16, (1 << 20) - 1
+        ls = build_link_state(
+            {"1": [adj("1", "2")], "2": [adj("2", "1")]},
+            labels={"1": lo, "2": hi},
+        )
+        ps = prefix_state_with(("2", "0", PrefixEntry(prefix=PFX)))
+        db = routes("1", {"0": ls}, ps)
+        assert lo in db.mpls_routes and hi in db.mpls_routes
+
+    def test_label_above_max_skipped(self):
+        ls = build_link_state(
+            {"1": [adj("1", "2")], "2": [adj("2", "1")]},
+            labels={"1": 101, "2": 1 << 20},
+        )
+        ps = prefix_state_with(("2", "0", PrefixEntry(prefix=PFX)))
+        db = routes("1", {"0": ls}, ps)
+        assert (1 << 20) not in db.mpls_routes
+        assert PFX in db.unicast_routes  # unicast unaffected
+
+    def test_label_below_min_skipped(self):
+        ls = build_link_state(
+            {"1": [adj("1", "2")], "2": [adj("2", "1")]},
+            labels={"1": 101, "2": 15},
+        )
+        ps = prefix_state_with(("2", "0", PrefixEntry(prefix=PFX)))
+        db = routes("1", {"0": ls}, ps)
+        assert 15 not in db.mpls_routes
+        assert 101 in db.mpls_routes  # own POP_AND_LOOKUP route intact
+
+    def test_invalid_adj_label_skipped(self):
+        ls = build_link_state(
+            {"1": [adj("1", "2")], "2": [adj("2", "1")]},
+            labels={"1": 101, "2": 102},
+        )
+        for link in ls.links_from_node("1"):
+            link.set_adj_label_from_node("1", (1 << 20) + 5)
+        ls._invalidate()
+        ps = prefix_state_with(("2", "0", PrefixEntry(prefix=PFX)))
+        db = routes("1", {"0": ls}, ps)
+        assert ((1 << 20) + 5) not in db.mpls_routes
+
+    def test_relabel_invalid_to_valid(self):
+        ls = build_link_state(
+            {"1": [adj("1", "2")], "2": [adj("2", "1")]},
+            labels={"1": 101, "2": 1 << 20},
+        )
+        ps = prefix_state_with(("2", "0", PrefixEntry(prefix=PFX)))
+        db = routes("1", {"0": ls}, ps)
+        assert (1 << 20) not in db.mpls_routes
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="2",
+                adjacencies=[adj("2", "1")],
+                node_label=500,
+                area="0",
+            )
+        )
+        db = routes("1", {"0": ls}, ps)
+        assert 500 in db.mpls_routes
+
+
+class TestMultiEventSequences:
+    """Ancestors: the longer DecisionTestFixture sequences
+    (BasicOperations :4787, PubDebouncing :6024, DuplicatePrefixes
+    :6267) — adjacency churn, flap storms, withdraw/re-advertise, and
+    interactions with hold windows, asserted at the route level."""
+
+    @staticmethod
+    def sq_map(m12=10):
+        return {
+            "1": [adj("1", "2", metric=m12), adj("1", "3")],
+            "2": [adj("2", "1", metric=m12), adj("2", "4")],
+            "3": [adj("3", "1"), adj("3", "4")],
+            "4": [adj("4", "2"), adj("4", "3")],
+        }
+
+    def test_flap_storm_final_state(self):
+        ls = build_link_state(self.sq_map())
+        ps = prefix_state_with(("4", "0", PrefixEntry(prefix=PFX)))
+        # 6 alternating flaps of the 1-2 arm (reported by node 1)
+        for i in range(6):
+            adjs = (
+                [adj("1", "3")]
+                if i % 2 == 0
+                else [adj("1", "2"), adj("1", "3")]
+            )
+            ls.update_adjacency_database(
+                AdjacencyDatabase(
+                    this_node_name="1", adjacencies=adjs, area="0"
+                )
+            )
+            db = routes("1", {"0": ls}, ps)
+            expected = {"3"} if i % 2 == 0 else {"2", "3"}
+            assert nh_names(db.unicast_routes[PFX]) == expected, i
+        # final state equals a freshly-built equivalent topology
+        fresh = build_link_state(self.sq_map())
+        db_churned = routes("1", {"0": ls}, ps)
+        db_fresh = routes("1", {"0": fresh}, ps)
+        assert db_churned.unicast_routes == db_fresh.unicast_routes
+
+    def test_churn_during_hold_falls_back_to_fast_update(self):
+        ls = build_link_state(self.sq_map())
+        ps = prefix_state_with(("4", "0", PrefixEntry(prefix=PFX)))
+        # two metric updates inside one hold window: 10 -> 50 -> 9.
+        # Reference semantics (HoldableValue::updateValue,
+        # LinkState.cpp:93-98): a second change while a hold is active
+        # CANCELS the hold ("fall back to fast update" — holding longer
+        # risks longer transient loops), so the final value applies
+        # immediately, not at decrement time.
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="1",
+                adjacencies=[adj("1", "2", metric=50), adj("1", "3")],
+                area="0",
+            ),
+            hold_up_ttl=3,
+            hold_down_ttl=3,
+        )
+        db = routes("1", {"0": ls}, ps)
+        assert nh_names(db.unicast_routes[PFX]) == {"2", "3"}  # held at 10
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="1",
+                adjacencies=[adj("1", "2", metric=9), adj("1", "3")],
+                area="0",
+            ),
+            hold_up_ttl=3,
+            hold_down_ttl=3,
+        )
+        db = routes("1", {"0": ls}, ps)
+        # metric 9 visible immediately: 1->2->4 costs 19 < 1->3->4 20
+        assert nh_names(db.unicast_routes[PFX]) == {"2"}
+        assert not ls.has_holds()
+
+    def test_node_delete_and_readd(self):
+        ls = build_link_state(self.sq_map())
+        ps = prefix_state_with(("4", "0", PrefixEntry(prefix=PFX)))
+        assert nh_names(routes("1", {"0": ls}, ps).unicast_routes[PFX]) == {
+            "2",
+            "3",
+        }
+        change = ls.delete_adjacency_database("2")
+        assert change.topology_changed
+        db = routes("1", {"0": ls}, ps)
+        assert nh_names(db.unicast_routes[PFX]) == {"3"}
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="2",
+                adjacencies=[adj("2", "1"), adj("2", "4")],
+                area="0",
+            )
+        )
+        db = routes("1", {"0": ls}, ps)
+        assert nh_names(db.unicast_routes[PFX]) == {"2", "3"}
+
+    def test_withdraw_readvertise_different_node(self):
+        ls = build_link_state(self.sq_map())
+        ps = prefix_state_with(("4", "0", PrefixEntry(prefix=PFX)))
+        assert PFX in routes("1", {"0": ls}, ps).unicast_routes
+        ps.delete_prefix("4", "0", PFX)
+        db = routes("1", {"0": ls}, ps)
+        assert PFX not in db.unicast_routes
+        ps.update_prefix("2", "0", PrefixEntry(prefix=PFX))
+        db = routes("1", {"0": ls}, ps)
+        assert nh_names(db.unicast_routes[PFX]) == {"2"}
+
+    def test_overload_toggle_sequence(self):
+        ps = prefix_state_with(("4", "0", PrefixEntry(prefix=PFX)))
+        ls = build_link_state(self.sq_map())
+        for overloaded, expected in (
+            (True, {"3"}),
+            (False, {"2", "3"}),
+            (True, {"3"}),
+        ):
+            ls.update_adjacency_database(
+                AdjacencyDatabase(
+                    this_node_name="2",
+                    adjacencies=[adj("2", "1"), adj("2", "4")],
+                    is_overloaded=overloaded,
+                    area="0",
+                )
+            )
+            db = routes("1", {"0": ls}, ps)
+            assert nh_names(db.unicast_routes[PFX]) == expected
+
+    def test_hold_then_node_delete_no_stale_routes(self):
+        ls = build_link_state(self.sq_map())
+        ps = prefix_state_with(("4", "0", PrefixEntry(prefix=PFX)))
+        # 2 drains under a hold, then disappears entirely before the
+        # hold decrements: deletion must not leave held state behind
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="2",
+                adjacencies=[adj("2", "1"), adj("2", "4")],
+                is_overloaded=True,
+                area="0",
+            ),
+            hold_up_ttl=4,
+            hold_down_ttl=4,
+        )
+        ls.delete_adjacency_database("2")
+        db = routes("1", {"0": ls}, ps)
+        assert nh_names(db.unicast_routes[PFX]) == {"3"}
+        while ls.has_holds():
+            ls.decrement_holds()
+        db = routes("1", {"0": ls}, ps)
+        assert nh_names(db.unicast_routes[PFX]) == {"3"}
