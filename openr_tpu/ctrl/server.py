@@ -408,8 +408,8 @@ class OpenrCtrlHandler:
         decision = self._need(self.decision, "decision")
         db = decision.get_route_db()
         return {
-            "unicast_routes": db.unicast_routes,
-            "mpls_routes": db.mpls_routes,
+            "unicastRoutes": db.unicast_routes,
+            "mplsRoutes": db.mpls_routes,
         }
 
     def _spark_neighbors(self, p: dict) -> list[dict]:

@@ -118,9 +118,12 @@ class FleetRouteView:
     # -- device round --------------------------------------------------------
 
     def compute(self, hint_seed: Optional[int] = None) -> None:
-        """ONE device round: P-source reverse SSSP + fused ECMP bitmaps.
-        `hint_seed` carries the previous view's learned sweep count across
-        topology versions (same-shape seeding)."""
+        """One device ROUND — the P-source reverse relax plus the ECMP
+        bitmap pass (two pipelined dispatches; reduced_all_sources
+        defaults to unfused on the round-5 measurement that the
+        single-program fusion schedules worse).  `hint_seed` carries the
+        previous view's learned sweep count across topology versions
+        (same-shape seeding)."""
         from ..ops import allsources as asrc
 
         dest_ids = np.asarray(

@@ -1,0 +1,177 @@
+"""Thrift-binary wire shim: a stock-openr-shaped listener over KvStore.
+
+Demonstrates the cross-stack exchange the ARCHITECTURE.md decision
+record scoped: a client speaking the thrift Binary protocol over framed
+transport (what `thrift.TBinaryProtocol`/`TFramedTransport` produce —
+the encoding a stock openr tool emits when pointed at a plain
+thrift-binary endpoint) can call
+
+    getKvStoreKeyVals(1: list<string> filterKeys) -> Publication
+    getKvStoreKeyValsArea(1: filterKeys, 2: area)  -> Publication
+    setKvStoreKeyVals(1: KeySetParams, 2: area)    -> void
+
+against this daemon (reference signatures:
+openr/if/OpenrCtrl.thrift:398-427).  Unknown methods get a
+TApplicationException, exactly as a thrift server would answer.
+
+This deliberately does NOT implement fbthrift's rocket/header transport
+(the reference's default in-fleet transport) — that remains the recorded
+divergence; the shim covers the stable, documented thrift Binary+framed
+stack that thrift-generated clients in any language can select.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct as _s
+from typing import Optional
+
+from ..runtime.eventbase import OpenrEventBase
+from . import thrift_binary as tb
+
+log = logging.getLogger(__name__)
+
+MAX_FRAME = 64 * 1024 * 1024
+
+# argument StructSpecs (module constants: the shim decodes at wire rate)
+_GET_ARGS = tb.StructSpec(
+    "getKvStoreKeyVals_args",
+    None,
+    (
+        tb.Field(
+            1,
+            "filter_keys",
+            ("list", tb.T_STRING),
+            dec=lambda xs: [x.decode() for x in xs],
+            default=[],
+        ),
+    ),
+)
+_GET_AREA_ARGS = tb.StructSpec(
+    "getKvStoreKeyValsArea_args",
+    None,
+    _GET_ARGS.fields
+    + (
+        tb.Field(
+            2, "area", tb.T_STRING, dec=lambda b: b.decode(), default="0"
+        ),
+    ),
+)
+_SET_ARGS = tb.StructSpec(
+    "setKvStoreKeyVals_args",
+    None,
+    (
+        tb.Field(1, "set_params", ("struct", tb.KEY_SET_PARAMS)),
+        tb.Field(
+            2, "area", tb.T_STRING, dec=lambda b: b.decode(), default="0"
+        ),
+    ),
+)
+
+
+class ThriftBinaryShim(OpenrEventBase):
+    """Framed thrift-binary listener fronting a KvStore instance."""
+
+    def __init__(
+        self, kvstore, host: str = "::1", port: int = 0
+    ) -> None:
+        super().__init__(name="thrift-shim")
+        self.kvstore = kvstore
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    def run(self) -> None:
+        super().run()
+        self.wait_until_running()
+        self.run_coroutine(self._start()).result(timeout=10)
+
+    async def _start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_conn, host=self.host, port=self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    def stop(self) -> None:
+        if self._server is not None and self._loop is not None:
+            server, self._server = self._server, None
+
+            def _close() -> None:
+                server.close()
+
+            try:
+                self.run_in_event_base_thread(_close).result(timeout=5)
+            except Exception:
+                pass
+        super().stop()
+
+    async def _handle_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            while True:
+                head = await reader.readexactly(4)
+                (length,) = _s.unpack("!i", head)
+                if not 0 < length <= MAX_FRAME:
+                    raise tb.ThriftError(f"bad frame length {length}")
+                msg = await reader.readexactly(length)
+                reply = self._serve(msg)
+                writer.write(tb.frame(reply))
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        except tb.ThriftError as exc:
+            log.warning("thrift shim: %s", exc)
+        finally:
+            writer.close()
+
+    # -- dispatch ------------------------------------------------------------
+
+    def _serve(self, msg: bytes) -> bytes:
+        name, mtype, seqid, r = tb.decode_message(msg)
+        if mtype != tb.MSG_CALL:
+            return tb.encode_application_exception(
+                name, seqid, f"unexpected message type {mtype}"
+            )
+        try:
+            if name == "getKvStoreKeyVals":
+                args = tb.read_struct(r, _GET_ARGS)
+                pub = self.kvstore.get_key_vals("0", args["filter_keys"])
+                return self._reply(name, seqid, ("struct", tb.PUBLICATION), pub)
+            if name == "getKvStoreKeyValsArea":
+                args = tb.read_struct(r, _GET_AREA_ARGS)
+                pub = self.kvstore.get_key_vals(
+                    args["area"], args["filter_keys"]
+                )
+                return self._reply(name, seqid, ("struct", tb.PUBLICATION), pub)
+            if name == "setKvStoreKeyVals":
+                args = tb.read_struct(r, _SET_ARGS)
+                params = args["set_params"]
+                self.kvstore.set_key_vals(
+                    args["area"],
+                    params["key_vals"],
+                    node_ids=params.get("node_ids"),
+                    flood_root_id=params.get("flood_root_id"),
+                )
+                return self._reply(name, seqid, None, None)
+        except tb.ThriftError:
+            raise
+        except Exception as exc:  # surfaced as a thrift exception
+            log.warning("thrift shim %s failed: %s", name, exc)
+            return tb.encode_application_exception(name, seqid, str(exc))
+        return tb.encode_application_exception(
+            name, seqid, f"unknown method {name!r}"
+        )
+
+    @staticmethod
+    def _reply(name: str, seqid: int, success_spec, value) -> bytes:
+        """Reply payload: struct with the success value at field 0 (void
+        replies carry an empty struct)."""
+        w = tb._Writer()
+        if success_spec is not None:
+            w.u8(tb._ttype_of(success_spec))
+            w.i16(0)
+            tb._write_value(w, success_spec, value)
+        w.u8(tb.T_STOP)
+        return tb.encode_message(name, tb.MSG_REPLY, seqid, w.getvalue())

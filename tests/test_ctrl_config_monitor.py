@@ -372,7 +372,7 @@ class TestCtrlDeltaRpcs:
         client = CtrlClient(port=daemon.ctrl_port)
         try:
             detail = client.call("getRouteDetailDb")
-            assert set(detail) == {"unicast_routes", "mpls_routes"}
+            assert set(detail) == {"unicastRoutes", "mplsRoutes"}
         finally:
             client.close()
 
