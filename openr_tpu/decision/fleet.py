@@ -38,9 +38,20 @@ import numpy as np
 
 from .link_state import LinkState
 
-# mirrors ops.sssp.INF32 (a plain int here so importing the decision layer
-# does not pull jax; tests assert the two stay equal)
+# mirrors ops.sssp.INF32 / ops.banded.INF16 (plain ints here so importing
+# the decision layer does not pull jax; tests/test_fleet.py asserts both
+# stay equal to the ops constants)
 INF32 = 1 << 30
+INF16 = 40000
+
+
+def _col_i32(col: np.ndarray) -> np.ndarray:
+    """Normalize a fetched distance column to the int32/INF32 contract —
+    the device product runs raw uint16 (INF16 sentinel) when the banded
+    kernel's small-distance mode engages (ops.banded raw_u16)."""
+    if col.dtype == np.uint16:
+        return np.where(col >= INF16, INF32, col.astype(np.int32))
+    return col
 
 log = logging.getLogger(__name__)
 
@@ -166,7 +177,7 @@ class FleetRouteView:
         i = self._node_id[node]
         hit = self._cols.get(i)
         if hit is None:
-            hit = np.asarray(self._dist_dev[:, i])
+            hit = _col_i32(np.asarray(self._dist_dev[:, i]))
             self._cols[i] = hit
         return hit
 
@@ -178,8 +189,12 @@ class FleetRouteView:
         missing = [i for i in ids if i not in self._cols]
         if not missing:
             return
-        cols = np.asarray(
-            jnp.take(self._dist_dev, jnp.asarray(missing, jnp.int32), axis=1)
+        cols = _col_i32(
+            np.asarray(
+                jnp.take(
+                    self._dist_dev, jnp.asarray(missing, jnp.int32), axis=1
+                )
+            )
         )
         for k, i in enumerate(missing):
             self._cols[i] = cols[:, k]

@@ -116,7 +116,13 @@ class ThriftBinaryShim(OpenrEventBase):
                 if not 0 < length <= MAX_FRAME:
                     raise tb.ThriftError(f"bad frame length {length}")
                 msg = await reader.readexactly(length)
-                reply = self._serve(msg)
+                # the KvStore calls block on a cross-thread Future with no
+                # timeout; off the loop thread so one busy/stopped KvStore
+                # cannot wedge every other shim connection (and stop()'s
+                # _close, which runs on this same loop)
+                reply = await asyncio.get_running_loop().run_in_executor(
+                    None, self._serve, msg
+                )
                 writer.write(tb.frame(reply))
                 await writer.drain()
         except (asyncio.IncompleteReadError, ConnectionResetError):

@@ -290,7 +290,11 @@ def _skip(r: _Reader, ttype: int) -> None:
 def write_struct(w: _Writer, spec: StructSpec, obj) -> None:
     for f in spec.fields:
         v = obj.get(f.name) if isinstance(obj, dict) else getattr(obj, f.name)
-        if f.enc is not None:
+        if v is None:
+            # mirror the decode side: a declared default fills an omitted
+            # non-optional field (decoded-domain value, so before enc)
+            v = f.default
+        if v is not None and f.enc is not None:
             v = f.enc(v)
         if v is None:
             if f.optional:

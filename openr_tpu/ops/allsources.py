@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .sssp import INF32
+from .banded import INF16, WBIG16
 
 
 class OutEll(NamedTuple):
@@ -121,16 +122,25 @@ def ecmp_bitmap_from_reverse_dist(
     p_dim = drev.shape[0]
     bitmap = jnp.zeros((n, p_dim, n_words), dtype=jnp.uint32)
     d_self = drev_T[:n]  # [N, P]
+    # uint16 domain (raw banded distances, INF16 sentinel): the gathers
+    # move half the bytes.  Safe because finite d < INF16=40000 and
+    # clamped metric <= WBIG16=20000 never wrap in uint16, and a finite
+    # d_nbr with a usable edge implies a finite d_self (so the
+    # d_nbr + w == d_self compare never matches a saturated self).
+    u16 = drev.dtype == jnp.uint16
+    inf = INF16 if u16 else INF32
     for k in range(k_pad):
         eidk = out.eid[:, k]
         ok = (eidk >= 0) & jnp.take(edge_up, jnp.maximum(eidk, 0))
         w = jnp.take(edge_metric, jnp.maximum(eidk, 0))  # [N]
+        if u16:
+            w = jnp.minimum(w, jnp.int32(WBIG16)).astype(jnp.uint16)
         nbr = out.nbr[:, k]
         d_nbr = jnp.take(drev_T, nbr, axis=0)  # [N, P]
         nbr_ov = jnp.take(node_overloaded, nbr)  # [N]
         on = (
             ok[:, None]
-            & (d_nbr < INF32)
+            & (d_nbr < inf)
             & (d_nbr + w[:, None] == d_self)
             & (~nbr_ov[:, None] | (d_nbr == 0))
         )  # [N, P]
@@ -163,8 +173,11 @@ def reduced_all_sources(
     fused: bool = False,
 ):
     """Fleet-wide route-building input in one device round:
-    (dist [P, N*] int32 jax — dist[p, v] = dist(v -> p), nh_bitmap
-    [N, P, W] uint32 jax, converged bool).
+    (dist [P, N*] jax — dist[p, v] = dist(v -> p), nh_bitmap
+    [N, P, W] uint32 jax, converged bool).  dist is raw uint16 with the
+    INF16 sentinel when the banded kernel's small-distance mode engages
+    (half the bitmap-gather bytes), int32/INF32 otherwise — consumers
+    key on dtype (decision.fleet._col_i32).
 
     `reverse_runner` is an ops.banded.SpfRunner over the REVERSED edge
     arrays (benchmarks.synthetic.reversed_topology / csr mirror).  With
@@ -200,8 +213,10 @@ def reduced_all_sources(
                 node_overloaded,
                 sweeps,
             )
+        # raw uint16 distances when the banded kernel runs small: the
+        # bitmap pass gathers half the bytes (ecmp_bitmap keys on dtype)
         dist, _, ok = reverse_runner.run_once(
-            dest_ids, sweeps, want_dag=False
+            dest_ids, sweeps, want_dag=False, raw_u16=True
         )
         return dist, None, ok
 
@@ -235,6 +250,7 @@ def reduced_all_sources(
         "resid_rounds",
         "small_dist",
         "n_words",
+        "chord_mode",
     ),
 )
 def _fused_product_banded(
@@ -253,6 +269,7 @@ def _fused_product_banded(
     resid_rounds: int,
     small_dist: bool,
     n_words: int,
+    chord_mode: bool = False,
 ):
     """Reverse relax + fleet ECMP bitmaps as ONE compiled program (banded
     path).  Bitmaps are computed unconditionally; on a failed convergence
@@ -260,6 +277,7 @@ def _fused_product_banded(
     from .banded import spf_forward_banded
 
     # spf_forward_banded returns dist [S, N] == the [P, N*] drev layout
+    # (raw uint16 when small — the bitmap pass then gathers half bytes)
     dist, _, ok = spf_forward_banded(
         dest_ids,
         bg,
@@ -273,6 +291,8 @@ def _fused_product_banded(
         resid_rounds=resid_rounds,
         small_dist=small_dist,
         want_dag=False,
+        chord_mode=chord_mode,
+        raw_u16=True,
     )
     bitmap = ecmp_bitmap_from_reverse_dist(
         dist, out, f_edge_metric, f_edge_up, node_overloaded, n_words
@@ -309,4 +329,5 @@ def _fused_product(
         resid_rounds=reverse_runner.resid_rounds,
         small_dist=reverse_runner.small_dist,
         n_words=out.n_words,
+        chord_mode=reverse_runner.chord_mode,
     )

@@ -239,6 +239,7 @@ def _resid_tables(bg, edge_up, edge_metric, node_overloaded, wbig):
         "depth",
         "resid_rounds",
         "small_dist",
+        "chord_mode",
     ),
 )
 def batched_sssp_banded(
@@ -252,16 +253,30 @@ def batched_sssp_banded(
     resid_rounds: int = 1,
     row_allowed_T: Optional[jax.Array] = None,  # [E_cap, S] bool
     small_dist: bool = False,
+    chord_mode: bool = False,
 ):
     """Fixed-supersweep banded relaxation.  Returns (dist [N, S] in
-    ORIGINAL node order, converged bool).  See module docstring."""
+    ORIGINAL node order, converged bool).  See module docstring.
+
+    ``chord_mode`` swaps the sequential supersweep for the two-pass
+    Jacobi form measured fastest on chord-rich small-world graphs
+    (round-5 tune, wan100k P=1024): ONE fused min over all residual
+    gather candidates, then ONE fused min over all depth-0 band shifts.
+    Fewer, larger fusions cut the per-sweep HBM traffic ~30% and the
+    composed band levels (pure overhead when the supersweep count is
+    floored by chord-hop depth) are skipped; the chord-mode fixed point
+    needs a few more supersweeps (18 vs 14 at wan100k), which the
+    runner's adaptive hint learns.  Verification stays the sequential
+    exact relax, so the convergence verdict is unchanged."""
     n = bg.n_nodes
     inf = INF16 if small_dist else INF32
     wbig = WBIG16 if small_dist else WBIG
     ddt = dist0.dtype
     ov_n = node_overloaded[:n]
 
-    band_tabs = _band_tables(bg, edge_up, edge_metric, ov_n, depth, wbig)
+    band_tabs = _band_tables(
+        bg, edge_up, edge_metric, ov_n, 0 if chord_mode else depth, wbig
+    )
     rw, rov = _resid_tables(bg, edge_up, edge_metric, ov_n, wbig)
 
     # per-row exclusions: residual slot masks + band cut positions
@@ -281,30 +296,34 @@ def batched_sssp_banded(
         resid_excl = None
         band_cut0 = None
 
+    def resid_cand(d, k):
+        du = jnp.take(d, bg.resid_nbr[:, k], axis=0)  # [N, S]
+        allow = (rw[:, k] < wbig)[:, None] & (
+            ~rov[:, k][:, None] | (du == 0)
+        )
+        if resid_excl is not None:
+            allow &= ~resid_excl[:, k]
+        return jnp.where(
+            allow & (du < inf), du + rw[:, k][:, None].astype(ddt), inf
+        )
+
     def relax_resid(d):
         for k in range(bg.resid_nbr.shape[1]):
-            du = jnp.take(d, bg.resid_nbr[:, k], axis=0)  # [N, S]
-            allow = (rw[:, k] < wbig)[:, None] & (
-                ~rov[:, k][:, None] | (du == 0)
-            )
-            if resid_excl is not None:
-                allow &= ~resid_excl[:, k]
-            cand = jnp.where(
-                allow & (du < inf), du + rw[:, k][:, None].astype(ddt), inf
-            )
-            d = jnp.minimum(d, cand)
+            d = jnp.minimum(d, resid_cand(d, k))
         return d
 
-    def relax_band0(d, b):
-        """Depth-0 band relax with the exact source exception."""
+    def band0_cand(d, b):
+        """Depth-0 band relax candidate with the exact source exception."""
         c = bg.offsets[b]
         w0, ov, _ = band_tabs[b]
         du = jnp.roll(d, c, axis=0)
         allow = (w0 < wbig) & (~ov | (du == 0))
         if band_cut0 is not None:
             allow = allow & ~band_cut0[b]
-        cand = jnp.where(allow & (du < inf), du + w0.astype(ddt), inf)
-        return jnp.minimum(d, cand)
+        return jnp.where(allow & (du < inf), du + w0.astype(ddt), inf)
+
+    def relax_band0(d, b):
+        return jnp.minimum(d, band0_cand(d, b))
 
     def relax_band_levels(d, b):
         """Composed-shift relaxes (transit-blocked; no source exception)."""
@@ -325,6 +344,18 @@ def batched_sssp_banded(
         return d
 
     def supersweep(d):
+        if chord_mode:
+            # two fused Jacobi passes: all residual gathers in one min,
+            # then all depth-0 band shifts in one min
+            d = functools.reduce(
+                jnp.minimum,
+                [d]
+                + [resid_cand(d, k) for k in range(bg.resid_nbr.shape[1])],
+            )
+            return functools.reduce(
+                jnp.minimum,
+                [d] + [band0_cand(d, b) for b in range(len(bg.offsets))],
+            )
         for _ in range(resid_rounds):
             d = relax_resid(d)
         for b in range(len(bg.offsets)):
@@ -334,10 +365,17 @@ def batched_sssp_banded(
 
     d = jax.lax.fori_loop(0, n_supersweeps, lambda i, d: supersweep(d), dist0)
 
-    # verification: depth-0 bands + residual = one exact full relax
-    v = relax_resid(d)
-    for b in range(len(bg.offsets)):
-        v = relax_band0(v, b)
+    # verification: depth-0 bands + residual cover every edge with exact
+    # drain semantics, so v == d certifies the fixed point.  The Jacobi
+    # form (chord mode) is an equally exact CHECK: v == d iff no single
+    # edge improves on d, the same fixed-point condition the sequential
+    # pass tests — and it reuses the cheaper fused-pass structure.
+    if chord_mode:
+        v = supersweep(d)
+    else:
+        v = relax_resid(d)
+        for b in range(len(bg.offsets)):
+            v = relax_band0(v, b)
     return v, jnp.all(v == d)
 
 
@@ -350,6 +388,8 @@ def batched_sssp_banded(
         "small_dist",
         "use_link_metric",
         "want_dag",
+        "chord_mode",
+        "raw_u16",
     ),
 )
 def spf_forward_banded(
@@ -367,11 +407,20 @@ def spf_forward_banded(
     small_dist: bool = False,
     use_link_metric: bool = True,
     want_dag: bool = True,
+    chord_mode: bool = False,
+    raw_u16: bool = False,
 ):
     """Banded forward pass: distances (+ optional SP-DAG) + convergence
     verdict.  Output contract matches ops.sssp.spf_forward_ell — dist
     [S, N] int32 (INF32 unreachable), dag [S, E_cap] — so callers can
-    swap kernels by topology shape."""
+    swap kernels by topology shape.
+
+    ``raw_u16`` (uint16 runs, want_dag=False only) returns dist [S, N]
+    in the raw uint16 domain (INF16 unreachable) instead of int32 —
+    consumers that stay on device (the reduced all-sources bitmap pass)
+    then move half the bytes.  The saturation guard still gates
+    ``converged``; on a False verdict callers retry via the runner's
+    int32 fallback exactly as before."""
     from .sssp import make_relax_allowed_T, sp_dag_mask_from_T
 
     metric = edge_metric if use_link_metric else jnp.ones_like(edge_metric)
@@ -402,6 +451,7 @@ def spf_forward_banded(
         resid_rounds=resid_rounds,
         row_allowed_T=row_allowed_T,
         small_dist=small_dist,
+        chord_mode=chord_mode,
     )
     dist16 = None
     if small_dist is True:
@@ -413,6 +463,8 @@ def spf_forward_banded(
         fin_max = jnp.max(jnp.where(dist < INF16, dist, jnp.uint16(0)))
         converged = converged & (fin_max < WBIG16)
         dist16 = dist
+        if raw_u16 and not want_dag:
+            return dist16.T, None, converged
         dist = jnp.where(dist >= INF16, INF32, dist.astype(jnp.int32))
     if not want_dag:
         return dist.T, None, converged
@@ -480,18 +532,21 @@ class SpfRunner:
         self.bg = bg
         self.arrays = (edge_src, edge_dst, edge_metric, edge_up, node_overloaded)
         self.n_edges = n_edges
+        # measured (round-5 tune, wan100k P=1024): on chord-rich
+        # small-world graphs the supersweep count is floored by CHORD hop
+        # depth, so composed band levels are pure overhead, and the
+        # two-pass Jacobi supersweep (chord_mode) wins another ~30% on
+        # per-sweep HBM traffic (18x11.6ms vs 14x17.0ms sequential).
+        # Band-dominated topologies (grids: long straight runs) still
+        # need the sequential sweep with composed levels.
+        self.chord_mode = False
         if depth is None:
-            # measured (round-5 tune, wan100k P=1024): on chord-rich
-            # small-world graphs the supersweep count is floored by CHORD
-            # hop depth (14 at both depth 1 and 2), so composed band
-            # levels are pure overhead — depth 1 won wall by ~15%.
-            # Band-dominated topologies (grids: long straight runs) still
-            # need the composed levels.
             if bg is not None and n_edges > 0:
                 resid_frac = float(
                     (np.asarray(bg.resid_eid) >= 0).sum()
                 ) / float(n_edges)
-                depth = 1 if resid_frac > 0.25 else 2
+                self.chord_mode = resid_frac > 0.25
+                depth = 0 if self.chord_mode else 2
             else:
                 depth = 2
         self.depth = depth
@@ -548,11 +603,13 @@ class SpfRunner:
         guard also presents as non-convergence) — then refine the hint
         back DOWN with `probe(mid)` binary steps.
 
-        Refine-down is capped at 2 probes: doubling overshoots by up to
+        Refine-down is capped at 3 probes: doubling overshoots by up to
         2x and every later production dispatch would pay the surplus
         sweeps forever, but each distinct sweep count is a fresh XLA
-        compile (~tens of seconds at 100k), so land within ~12% of
-        minimal and stop.
+        compile (~tens of seconds at 100k), so land within ~6% of
+        minimal and stop.  (Raised from 2 in round 5: at wan100k the
+        third probe finds 18 instead of 20 supersweeps — ~10% of the
+        north-star relax — for one more one-time compile.)
 
         attempt(sweeps) -> (result, ok); probe(sweeps) -> ok (a cheaper
         call whose result is discarded); eff_small() -> the effective
@@ -567,7 +624,7 @@ class SpfRunner:
                 if doubled_from is not None:
                     lo, hi = doubled_from, sweeps
                     probes = 0
-                    while hi - lo > 1 and probes < 2:
+                    while hi - lo > 1 and probes < 3:
                         probes += 1
                         mid = (lo + hi) // 2
                         if probe(mid):
@@ -661,8 +718,11 @@ class SpfRunner:
         extra_edge_mask=None,
         want_dag: bool = True,
         metric_plane=None,
+        raw_u16: bool = False,
     ):
-        """One fixed-sweep device call; returns jax (dist, dag, ok)."""
+        """One fixed-sweep device call; returns jax (dist, dag, ok).
+        With ``raw_u16`` a uint16 banded run returns raw uint16
+        distances (INF16 sentinel) — callers must key on dist.dtype."""
         from .sssp import spf_forward_ell_sweeps
 
         edge_src, edge_dst, edge_metric, edge_up, node_overloaded = (
@@ -696,6 +756,8 @@ class SpfRunner:
                 small_dist=small,
                 use_link_metric=use_link_metric,
                 want_dag=want_dag,
+                chord_mode=self.chord_mode,
+                raw_u16=raw_u16,
             )
         return spf_forward_ell_sweeps(
             sources,

@@ -108,6 +108,7 @@ def _trace_paths(
         "small_dist",
         "max_hops",
         "k_in",
+        "chord_mode",
     ),
 )
 def fused_ksp2_banded(
@@ -128,6 +129,7 @@ def fused_ksp2_banded(
     small_dist: bool,
     max_hops: int,
     k_in: int,
+    chord_mode: bool = False,
 ) -> list[Ksp2PlaneResult]:
     """Per metric plane: base SPF -> trace -> edge-disjoint masked batch,
     ALL planes in this one program.  Edge-disjointness excludes both
@@ -154,6 +156,7 @@ def fused_ksp2_banded(
             resid_rounds=resid_rounds,
             small_dist=small_dist,
             want_dag=True,
+            chord_mode=chord_mode,
         )
         d_row = dist[0]
         dag_row = dag[0]
@@ -182,6 +185,7 @@ def fused_ksp2_banded(
             extra_edge_mask=mask,
             small_dist=small_dist,
             want_dag=False,
+            chord_mode=chord_mode,
         )
         k1 = jnp.take(d_row, dest_ids)
         k2 = dist2[rows, dest_ids]
@@ -260,6 +264,7 @@ class FusedKsp2Runner:
             small_dist=small,
             max_hops=max_hops,
             k_in=self.k_in,
+            chord_mode=r.chord_mode,
         )
 
     def _host_masks(self, res: list[Ksp2PlaneResult], d: int) -> list:

@@ -23,6 +23,15 @@ from openr_tpu.ops import sssp as ops
 from openr_tpu.ops.sssp import INF32
 
 
+def to_i32(dist) -> np.ndarray:
+    """Normalize a reduced-product distance matrix to int32/INF32: the
+    product returns raw uint16 (INF16 sentinel) when the banded kernel
+    runs in small-distance mode (ops.allsources contract)."""
+    from openr_tpu.decision.fleet import _col_i32
+
+    return _col_i32(np.asarray(dist))
+
+
 def oracle(topo, sources, extra_mask=None):
     import jax.numpy as jnp
 
@@ -117,6 +126,34 @@ class TestBandedKernel:
         assert_matches_oracle(w, np.arange(4))
         assert w.runner.hint > 1
 
+    def test_chord_mode_auto_pick(self):
+        """Chord-rich small worlds run the two-pass Jacobi supersweep;
+        band-dominated grids keep the sequential sweep with composed
+        levels (round-5 tune).  The oracle tests above exercise BOTH
+        supersweeps (wan picks chord mode, grid sequential) — this pins
+        the auto-pick itself."""
+        w = synthetic.wan(512, chords=2, seed=3)
+        assert w.runner.chord_mode
+        assert w.runner.depth == 0
+        g = synthetic.grid(8)
+        assert not g.runner.chord_mode
+        assert g.runner.depth == 2
+        # explicit depth bypasses the auto-pick
+        from openr_tpu.ops.banded import SpfRunner
+
+        r = SpfRunner(
+            w.ell,
+            w.banded,
+            w.edge_src,
+            w.edge_dst,
+            w.edge_metric,
+            w.edge_up,
+            w.node_overloaded,
+            w.n_edges,
+            depth=1,
+        )
+        assert not r.chord_mode and r.depth == 1
+
     def test_parallel_band_links_demoted_to_residual(self):
         # duplicate ring links (parallel edges on the same band offset)
         # must not collide in the band table
@@ -183,7 +220,7 @@ class TestReducedAllSources:
             w.node_overloaded,
         )
         assert bool(ok)
-        dist = np.asarray(dist)
+        dist = to_i32(dist)
         # forward oracle over a sample of routers
         sample = np.asarray([0, 3, 100, 255], np.int32)
         odist, _ = oracle(w, sample)
@@ -203,7 +240,7 @@ class TestReducedAllSources:
             w.node_overloaded,
         )
         assert bool(ok)
-        dist = np.asarray(dist)
+        dist = to_i32(dist)
         sample = np.asarray([0, 5, 60, 200], np.int32)
         odist, _ = oracle(w, sample)
         for i, v in enumerate(sample):
@@ -280,7 +317,7 @@ class TestReducedAllSources:
             w.node_overloaded,
         )
         assert bool(ok)
-        dist = np.asarray(dist)  # [P, N]
+        dist = to_i32(dist)  # [P, N]
         bitmap = np.asarray(bitmap)  # [N, P, W]
         e = w.n_edges
         src = w.edge_src[:e]

@@ -143,6 +143,18 @@ class TestRoundTrips:
         assert back["keys"] == ["adj:n1"]
         assert back["do_not_publish_value"] is True
 
+    def test_encode_fills_declared_defaults(self):
+        """A minimal dict omitting defaulted non-optional fields must
+        encode (the default fills in, mirroring the decode side) — a
+        client issuing setKvStoreKeyVals with only key_vals exercises
+        this."""
+        minimal = {"key_vals": {"k": Value(1, "me", b"v", -1, 0)}}
+        back = tb.decode_struct(
+            tb.KEY_SET_PARAMS, tb.encode_struct(tb.KEY_SET_PARAMS, minimal)
+        )
+        assert back["key_vals"] == minimal["key_vals"]
+        assert back["solicit_response"] is True  # declared default
+
     def test_peer_spec(self):
         ps = {
             "peer_addr": "fe80::1",
