@@ -119,7 +119,11 @@ class OpenrCtrlHandler:
         m["getKvStoreKeyValsFilteredArea"] = self._kvstore_dump_filtered
         m["getKvStoreHashFilteredArea"] = lambda p: self._need(
             self.kvstore, "kvstore"
-        ).dump_hashes(p.get("area", "0"), p.get("prefixes", []))
+        ).dump_hashes(
+            p.get("area", "0"),
+            p.get("prefixes", []),
+            p.get("originators", []),
+        )
         m["setKvStoreKeyVals"] = self._kvstore_set
         m["getKvStorePeersArea"] = lambda p: self._need(
             self.kvstore, "kvstore"

@@ -6,12 +6,17 @@ transport (what `thrift.TBinaryProtocol`/`TFramedTransport` produce —
 the encoding a stock openr tool emits when pointed at a plain
 thrift-binary endpoint) can call
 
-    getKvStoreKeyVals(1: list<string> filterKeys) -> Publication
+    getMyNodeName()                                -> string
+    getOpenrVersion()                              -> OpenrVersions
+    getKvStoreKeyVals(1: list<string> filterKeys)  -> Publication
     getKvStoreKeyValsArea(1: filterKeys, 2: area)  -> Publication
+    getKvStoreKeyValsFiltered[Area](1: filter, ..) -> Publication
+    getKvStoreHashFiltered[Area](1: filter, ..)    -> Publication
+    getKvStorePeers[Area](..)                      -> PeersMap
     setKvStoreKeyVals(1: KeySetParams, 2: area)    -> void
 
 against this daemon (reference signatures:
-openr/if/OpenrCtrl.thrift:398-427).  Unknown methods get a
+openr/if/OpenrCtrl.thrift:398-492, 560, 612).  Unknown methods get a
 TApplicationException, exactly as a thrift server would answer.
 
 This deliberately does NOT implement fbthrift's rocket/header transport
@@ -68,18 +73,44 @@ _SET_ARGS = tb.StructSpec(
         ),
     ),
 )
+_EMPTY_ARGS = tb.StructSpec("empty_args", None, ())
+_FILTER_ARGS = tb.StructSpec(
+    "filtered_args",
+    None,
+    (
+        tb.Field(1, "filter", ("struct", tb.KEY_DUMP_PARAMS)),
+        tb.Field(
+            2, "area", tb.T_STRING, dec=lambda b: b.decode(), default="0"
+        ),
+    ),
+)
+_AREA_ARGS = tb.StructSpec(
+    "area_args",
+    None,
+    (
+        tb.Field(
+            1, "area", tb.T_STRING, dec=lambda b: b.decode(), default="0"
+        ),
+    ),
+)
+_PEERS_MAP = ("map", tb.T_STRING, ("struct", tb.PEER_SPEC))
 
 
 class ThriftBinaryShim(OpenrEventBase):
     """Framed thrift-binary listener fronting a KvStore instance."""
 
     def __init__(
-        self, kvstore, host: str = "::1", port: int = 0
+        self,
+        kvstore,
+        host: str = "::1",
+        port: int = 0,
+        node_name: str = "",
     ) -> None:
         super().__init__(name="thrift-shim")
         self.kvstore = kvstore
         self.host = host
         self.port = port
+        self.node_name = node_name
         self._server: Optional[asyncio.AbstractServer] = None
 
     def run(self) -> None:
@@ -141,6 +172,27 @@ class ThriftBinaryShim(OpenrEventBase):
                 name, seqid, f"unexpected message type {mtype}"
             )
         try:
+            if name == "getMyNodeName":
+                tb.read_struct(r, _EMPTY_ARGS)
+                return self._reply(name, seqid, tb.T_STRING, self.node_name)
+            if name == "getOpenrVersion":
+                from ..ctrl.server import (
+                    OPENR_LOWEST_SUPPORTED_VERSION,
+                    OPENR_VERSION,
+                )
+
+                tb.read_struct(r, _EMPTY_ARGS)
+                return self._reply(
+                    name,
+                    seqid,
+                    ("struct", tb.OPENR_VERSIONS),
+                    {
+                        "version": OPENR_VERSION,
+                        "lowest_supported_version": (
+                            OPENR_LOWEST_SUPPORTED_VERSION
+                        ),
+                    },
+                )
             if name == "getKvStoreKeyVals":
                 args = tb.read_struct(r, _GET_ARGS)
                 pub = self.kvstore.get_key_vals("0", args["filter_keys"])
@@ -151,6 +203,50 @@ class ThriftBinaryShim(OpenrEventBase):
                     args["area"], args["filter_keys"]
                 )
                 return self._reply(name, seqid, ("struct", tb.PUBLICATION), pub)
+            if name in (
+                "getKvStoreKeyValsFiltered",
+                "getKvStoreKeyValsFilteredArea",
+                "getKvStoreHashFiltered",
+                "getKvStoreHashFilteredArea",
+            ):
+                args = tb.read_struct(r, _FILTER_ARGS)
+                filt = args["filter"]
+                prefixes = filt.get("keys") or (
+                    [filt["prefix"]] if filt.get("prefix") else []
+                )
+                originators = filt.get("originator_ids") or []
+                if "Hash" in name:
+                    pub = self.kvstore.dump_hashes(
+                        args["area"], prefixes, originators
+                    )
+                else:
+                    # the peer full-sync path: 3-way diff when the caller
+                    # sent its key_val_hashes, remaining-TTL adjustment
+                    # always (a dump_all here would re-arm full TTLs on
+                    # the remote side every sync)
+                    from ..kvstore.kvstore import KeyDumpParams
+
+                    pub = self.kvstore.process_full_dump(
+                        args["area"],
+                        KeyDumpParams(
+                            keys=prefixes,
+                            originator_ids=originators,
+                            key_val_hashes=filt.get("key_val_hashes"),
+                        ),
+                    )
+                return self._reply(name, seqid, ("struct", tb.PUBLICATION), pub)
+            if name in ("getKvStorePeers", "getKvStorePeersArea"):
+                args = tb.read_struct(r, _AREA_ARGS)
+                peers = self.kvstore.dump_peers(args["area"])
+                wire = {
+                    nm: {
+                        "peer_addr": ps.peer_addr,
+                        "ctrl_port": ps.ctrl_port,
+                        "state": int(ps.state),
+                    }
+                    for nm, ps in peers.items()
+                }
+                return self._reply(name, seqid, _PEERS_MAP, wire)
             if name == "setKvStoreKeyVals":
                 args = tb.read_struct(r, _SET_ARGS)
                 params = args["set_params"]

@@ -512,6 +512,17 @@ PEER_SPEC = StructSpec(
     ),
 )
 
+# openr/if/Types.thrift:1254 OpenrVersions {1: version,
+# 2: lowestSupportedVersion} (OpenrVersion = i32)
+OPENR_VERSIONS = StructSpec(
+    "OpenrVersions",
+    None,
+    (
+        Field(1, "version", T_I32, default=0),
+        Field(2, "lowest_supported_version", T_I32, default=0),
+    ),
+)
+
 # openr/if/Types.thrift:29 PerfEvent {1: nodeName, 2: eventDescr,
 # 3: unixTs}
 PERF_EVENT = StructSpec(

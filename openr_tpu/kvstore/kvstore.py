@@ -1456,9 +1456,12 @@ class KvStore(OpenrEventBase):
         )
 
     def dump_hashes(
-        self, area: str, key_prefixes: Iterable[str] = ()
+        self,
+        area: str,
+        key_prefixes: Iterable[str] = (),
+        originator_ids: Iterable[str] = (),
     ) -> Publication:
-        filters = KvStoreFilters(key_prefixes)
+        filters = KvStoreFilters(key_prefixes, originator_ids)
         return self._call(lambda: self._db(area).dump_hash_with_filters(filters))
 
     def process_full_dump(self, area: str, params: KeyDumpParams) -> Publication:

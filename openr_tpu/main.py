@@ -218,6 +218,7 @@ class OpenrDaemon:
         self.prefix_manager: Optional[PrefixManager] = None
         self.prefix_allocator: Optional[PrefixAllocator] = None
         self.ctrl_server: Optional[CtrlServer] = None
+        self.thrift_shim = None  # interop.shim.ThriftBinaryShim when enabled
         self._plugin = None
         self._plugin_handle = None
         self.netlink = None
@@ -329,6 +330,18 @@ class OpenrDaemon:
             tls=self._tls_config(),
         )
         self.ctrl_server.run()
+        if self.config.thrift_shim_port:
+            # stock-openr-shaped thrift Binary+framed listener over the
+            # same KvStore (openr_tpu.interop.shim)
+            from .interop.shim import ThriftBinaryShim
+
+            self.thrift_shim = ThriftBinaryShim(
+                self.kvstore,
+                host=self.config.listen_addr,
+                port=max(self.config.thrift_shim_port, 0),
+                node_name=self.config.node_name,
+            )
+            self.thrift_shim.run()
         if self.watchdog is not None:
             self.watchdog.add_evb(self.ctrl_server)
             self.watchdog.start()
@@ -364,6 +377,7 @@ class OpenrDaemon:
         for queue in self._queues:
             queue.close()
         modules = [
+            self.thrift_shim,
             self.ctrl_server,
             self.fib,
             self.decision,

@@ -213,7 +213,7 @@ class TestShimExchange:
             kvstore_transport=InProcessTransport().bind("shimd"),
         )
         daemon.start()
-        shim = ThriftBinaryShim(daemon.kvstore, port=0)
+        shim = ThriftBinaryShim(daemon.kvstore, port=0, node_name="shimd")
         shim.run()
         yield daemon, shim
         shim.stop()
@@ -285,3 +285,187 @@ class TestShimExchange:
             shim_srv.port, "noSuchRpc", 5, b"\x00"
         )
         assert name == "noSuchRpc" and mtype == tb.MSG_EXCEPTION
+
+    def test_meta_and_dump_methods(self, shim):
+        """getMyNodeName / getOpenrVersion / filtered dumps / peers —
+        reference signatures OpenrCtrl.thrift:412-492, 560, 612."""
+        daemon, shim_srv = shim
+        daemon.kvstore.set_key_vals(
+            "0", {"snoop:k1": Value(1, "shimd", b"a", -1, 0)}
+        )
+
+        # getMyNodeName() -> string
+        name, mtype, _s_, r = _thrift_call(
+            shim_srv.port, "getMyNodeName", 7, b"\x00"
+        )
+        assert mtype == tb.MSG_REPLY
+        reply = tb.read_struct(
+            r,
+            tb.StructSpec(
+                "result", None, (tb.Field(0, "success", tb.T_STRING),)
+            ),
+        )
+        assert reply["success"] == b"shimd"
+
+        # getOpenrVersion() -> OpenrVersions
+        name, mtype, _s_, r = _thrift_call(
+            shim_srv.port, "getOpenrVersion", 8, b"\x00"
+        )
+        assert mtype == tb.MSG_REPLY
+        reply = tb.read_struct(
+            r,
+            tb.StructSpec(
+                "result",
+                None,
+                (tb.Field(0, "success", ("struct", tb.OPENR_VERSIONS)),),
+            ),
+        )
+        assert reply["success"]["version"] >= reply["success"][
+            "lowest_supported_version"
+        ] > 0
+
+        # getKvStoreKeyValsFilteredArea(1: KeyDumpParams, 2: area)
+        filt_args = tb.encode_struct(
+            tb.StructSpec(
+                "args",
+                None,
+                (
+                    tb.Field(1, "filter", ("struct", tb.KEY_DUMP_PARAMS)),
+                    tb.Field(2, "area", tb.T_STRING),
+                ),
+            ),
+            {"filter": {"keys": ["snoop:"]}, "area": "0"},
+        )
+        name, mtype, _s_, r = _thrift_call(
+            shim_srv.port, "getKvStoreKeyValsFilteredArea", 9, filt_args
+        )
+        assert mtype == tb.MSG_REPLY
+        pub = tb.read_struct(
+            r,
+            tb.StructSpec(
+                "result",
+                None,
+                (tb.Field(0, "success", ("struct", tb.PUBLICATION)),),
+            ),
+        )["success"]
+        assert pub.key_vals["snoop:k1"].value == b"a"
+
+        # getKvStoreHashFiltered(1: KeyDumpParams) — hash dump: no values
+        hash_args = tb.encode_struct(
+            tb.StructSpec(
+                "args",
+                None,
+                (tb.Field(1, "filter", ("struct", tb.KEY_DUMP_PARAMS)),),
+            ),
+            {"filter": {"keys": ["snoop:"]}},
+        )
+        name, mtype, _s_, r = _thrift_call(
+            shim_srv.port, "getKvStoreHashFiltered", 10, hash_args
+        )
+        assert mtype == tb.MSG_REPLY
+        pub = tb.read_struct(
+            r,
+            tb.StructSpec(
+                "result",
+                None,
+                (tb.Field(0, "success", ("struct", tb.PUBLICATION)),),
+            ),
+        )["success"]
+        assert pub.key_vals["snoop:k1"].value is None
+        assert pub.key_vals["snoop:k1"].hash != 0
+
+        # filtered KeyVals dump rides the peer full-sync path: TTLs come
+        # back DECREMENTED to time remaining (a dump_all reply would
+        # re-arm full TTLs on the remote peer every sync)
+        daemon.kvstore.set_key_vals(
+            "0", {"snoop:ttl": Value(1, "shimd", b"t", 30000, 1)}
+        )
+        name, mtype, _s_, r = _thrift_call(
+            shim_srv.port, "getKvStoreKeyValsFilteredArea", 12,
+            tb.encode_struct(
+                tb.StructSpec(
+                    "args",
+                    None,
+                    (
+                        tb.Field(
+                            1, "filter", ("struct", tb.KEY_DUMP_PARAMS)
+                        ),
+                        tb.Field(2, "area", tb.T_STRING),
+                    ),
+                ),
+                {"filter": {"keys": ["snoop:ttl"]}, "area": "0"},
+            ),
+        )
+        assert mtype == tb.MSG_REPLY
+        pub = tb.read_struct(
+            r,
+            tb.StructSpec(
+                "result",
+                None,
+                (tb.Field(0, "success", ("struct", tb.PUBLICATION)),),
+            ),
+        )["success"]
+        assert 0 < pub.key_vals["snoop:ttl"].ttl_ms < 30000
+
+        # getKvStorePeersArea(1: area) -> map<string, PeerSpec>
+        name, mtype, _s_, r = _thrift_call(
+            shim_srv.port, "getKvStorePeersArea", 11,
+            tb.encode_struct(
+                tb.StructSpec(
+                    "args", None, (tb.Field(1, "area", tb.T_STRING),)
+                ),
+                {"area": "0"},
+            ),
+        )
+        assert mtype == tb.MSG_REPLY
+        peers = tb.read_struct(
+            r,
+            tb.StructSpec(
+                "result",
+                None,
+                (
+                    tb.Field(
+                        0,
+                        "success",
+                        ("map", tb.T_STRING, ("struct", tb.PEER_SPEC)),
+                        dec=lambda m: {k.decode(): v for k, v in m.items()},
+                    ),
+                ),
+            ),
+        )["success"]
+        assert peers == {}  # single-node daemon: no peers
+
+
+class TestDaemonShimWiring:
+    def test_daemon_starts_shim_from_config(self):
+        """thrift_shim_port=-1 starts the interop listener with the
+        daemon (ephemeral port) and tears it down with it."""
+        from openr_tpu.kvstore import InProcessTransport
+        from openr_tpu.main import OpenrDaemon
+        from openr_tpu.spark import MockIoProvider
+        from tests.test_system import make_config
+
+        cfg = make_config("shimw", ctrl_port=0)
+        cfg.thrift_shim_port = -1
+        fabric = MockIoProvider()
+        daemon = OpenrDaemon(
+            cfg,
+            io_provider=fabric.endpoint("shimw"),
+            kvstore_transport=InProcessTransport().bind("shimw"),
+        )
+        daemon.start()
+        try:
+            assert daemon.thrift_shim is not None
+            name, mtype, _s_, r = _thrift_call(
+                daemon.thrift_shim.port, "getMyNodeName", 1, b"\x00"
+            )
+            assert mtype == tb.MSG_REPLY
+            reply = tb.read_struct(
+                r,
+                tb.StructSpec(
+                    "result", None, (tb.Field(0, "success", tb.T_STRING),)
+                ),
+            )
+            assert reply["success"] == b"shimw"
+        finally:
+            daemon.stop()
