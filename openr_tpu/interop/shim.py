@@ -211,13 +211,30 @@ class ThriftBinaryShim(OpenrEventBase):
             ):
                 args = tb.read_struct(r, _FILTER_ARGS)
                 filt = args["filter"]
-                prefixes = filt.get("keys") or (
-                    [filt["prefix"]] if filt.get("prefix") else []
-                )
+                # the deprecated prefix field is COMMA-SEPARATED (the
+                # reference folly::split's it, KvStore.cpp:649; legacy
+                # breeze joins multiple --prefix args into it)
+                prefixes = filt.get("keys") or [
+                    p for p in (filt.get("prefix") or "").split(",") if p
+                ]
                 originators = filt.get("originator_ids") or []
+                # FilterOperator (Types.thrift:639): OR=1 (default), AND=2
+                match_all = filt.get("oper") == 2
+                hash_only = bool(filt.get("do_not_publish_value"))
                 if "Hash" in name:
                     pub = self.kvstore.dump_hashes(
                         args["area"], prefixes, originators
+                    )
+                elif match_all or hash_only:
+                    # display-oriented variants (same routing as the ctrl
+                    # server's _kvstore_dump_filtered): AND semantics /
+                    # values withheld
+                    pub = self.kvstore.dump_all(
+                        args["area"],
+                        key_prefixes=prefixes,
+                        originator_ids=originators,
+                        match_all=match_all,
+                        do_not_publish_value=hash_only,
                     )
                 else:
                     # the peer full-sync path: 3-way diff when the caller

@@ -150,6 +150,49 @@ class TestShortestPathEdgeCases:
         assert nh_names(db.unicast_routes[PFX]) == {"3"}
 
 
+class TestAttachedNodes:
+    """Ancestor: SimpleRingTopologyFixture.AttachedNodesTest
+    (DecisionTest.cpp:2921-2967): the default route is an ordinary
+    anycast prefix — advertisers ('attached' nodes) build NO route to
+    it themselves, everyone else ECMPs toward the nearest advertisers."""
+
+    DEFAULT = "::/0"
+
+    def _ps(self):
+        return prefix_state_with(
+            ("1", "0", PrefixEntry(prefix=PFX)),
+            ("1", "0", PrefixEntry(prefix=self.DEFAULT)),
+            ("4", "0", PrefixEntry(prefix="::4:0/112")),
+            ("4", "0", PrefixEntry(prefix=self.DEFAULT)),
+        )
+
+    def test_attached_advertiser_has_no_default_route(self):
+        for me in ("1", "4"):
+            db = routes(me, {"0": square()}, self._ps())
+            assert self.DEFAULT not in db.unicast_routes, me
+
+    def test_transit_nodes_ecmp_toward_nearest_attached(self):
+        # 2 and 3 sit at distance 10 from BOTH advertisers -> ECMP {1, 4}
+        for me in ("2", "3"):
+            db = routes(me, {"0": square()}, self._ps())
+            assert nh_names(db.unicast_routes[self.DEFAULT]) == {"1", "4"}, me
+
+    def test_default_follows_nearest_after_metric_change(self):
+        # pull node 2 toward node 4: the default route drops the farther
+        # advertiser (1) and keeps only 4
+        ls = build_link_state(
+            {
+                "1": [adj("1", "2"), adj("1", "3")],
+                "2": [adj("2", "1"), adj("2", "4", metric=1)],
+                "3": [adj("3", "1"), adj("3", "4")],
+                "4": [adj("4", "2", metric=1), adj("4", "3")],
+            },
+            labels={"1": 101, "2": 102, "3": 103, "4": 104},
+        )
+        db = routes("2", {"0": ls}, self._ps())
+        assert nh_names(db.unicast_routes[self.DEFAULT]) == {"4"}
+
+
 class TestParallelAdjacencies:
     """Ancestors: ParallelAdjRingTopologyFixture.ShortestPathTest /
     MultiPathTest (DecisionTest.cpp:3413, 3547), DecisionTestFixture.

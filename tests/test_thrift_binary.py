@@ -198,6 +198,20 @@ def _thrift_call(port: int, name: str, seqid: int, args: bytes) -> tuple:
     return tb.decode_message(data)
 
 
+def _result_spec(success_spec, dec=None):
+    """Reply struct: the success value at field 0."""
+    return tb.StructSpec(
+        "result", None, (tb.Field(0, "success", success_spec, dec=dec),)
+    )
+
+
+def _call_ok(port, name, seqid, args, success_spec, dec=None):
+    """Framed call + MSG_REPLY assert + decoded success value."""
+    got_name, mtype, got_seqid, r = _thrift_call(port, name, seqid, args)
+    assert (got_name, mtype, got_seqid) == (name, tb.MSG_REPLY, seqid)
+    return tb.read_struct(r, _result_spec(success_spec, dec))["success"]
+
+
 class TestShimExchange:
     @pytest.fixture
     def shim(self):
@@ -290,87 +304,85 @@ class TestShimExchange:
         """getMyNodeName / getOpenrVersion / filtered dumps / peers —
         reference signatures OpenrCtrl.thrift:412-492, 560, 612."""
         daemon, shim_srv = shim
+        port = shim_srv.port
         daemon.kvstore.set_key_vals(
             "0", {"snoop:k1": Value(1, "shimd", b"a", -1, 0)}
         )
+        filter_args = tb.StructSpec(
+            "args",
+            None,
+            (
+                tb.Field(1, "filter", ("struct", tb.KEY_DUMP_PARAMS)),
+                tb.Field(2, "area", tb.T_STRING, optional=True),
+            ),
+        )
 
         # getMyNodeName() -> string
-        name, mtype, _s_, r = _thrift_call(
-            shim_srv.port, "getMyNodeName", 7, b"\x00"
-        )
-        assert mtype == tb.MSG_REPLY
-        reply = tb.read_struct(
-            r,
-            tb.StructSpec(
-                "result", None, (tb.Field(0, "success", tb.T_STRING),)
-            ),
-        )
-        assert reply["success"] == b"shimd"
+        got = _call_ok(port, "getMyNodeName", 7, b"\x00", tb.T_STRING)
+        assert got == b"shimd"
 
         # getOpenrVersion() -> OpenrVersions
-        name, mtype, _s_, r = _thrift_call(
-            shim_srv.port, "getOpenrVersion", 8, b"\x00"
+        ver = _call_ok(
+            port,
+            "getOpenrVersion",
+            8,
+            b"\x00",
+            ("struct", tb.OPENR_VERSIONS),
         )
-        assert mtype == tb.MSG_REPLY
-        reply = tb.read_struct(
-            r,
-            tb.StructSpec(
-                "result",
-                None,
-                (tb.Field(0, "success", ("struct", tb.OPENR_VERSIONS)),),
-            ),
-        )
-        assert reply["success"]["version"] >= reply["success"][
-            "lowest_supported_version"
-        ] > 0
+        assert ver["version"] >= ver["lowest_supported_version"] > 0
 
         # getKvStoreKeyValsFilteredArea(1: KeyDumpParams, 2: area)
-        filt_args = tb.encode_struct(
-            tb.StructSpec(
-                "args",
-                None,
-                (
-                    tb.Field(1, "filter", ("struct", tb.KEY_DUMP_PARAMS)),
-                    tb.Field(2, "area", tb.T_STRING),
-                ),
+        pub = _call_ok(
+            port,
+            "getKvStoreKeyValsFilteredArea",
+            9,
+            tb.encode_struct(
+                filter_args, {"filter": {"keys": ["snoop:"]}, "area": "0"}
             ),
-            {"filter": {"keys": ["snoop:"]}, "area": "0"},
+            ("struct", tb.PUBLICATION),
         )
-        name, mtype, _s_, r = _thrift_call(
-            shim_srv.port, "getKvStoreKeyValsFilteredArea", 9, filt_args
-        )
-        assert mtype == tb.MSG_REPLY
-        pub = tb.read_struct(
-            r,
-            tb.StructSpec(
-                "result",
-                None,
-                (tb.Field(0, "success", ("struct", tb.PUBLICATION)),),
-            ),
-        )["success"]
         assert pub.key_vals["snoop:k1"].value == b"a"
 
+        # deprecated comma-separated prefix field (reference
+        # KvStore.cpp:649 folly::split; legacy breeze comma-joins)
+        pub = _call_ok(
+            port,
+            "getKvStoreKeyValsFiltered",
+            13,
+            tb.encode_struct(
+                filter_args, {"filter": {"prefix": "nomatch:,snoop:"}}
+            ),
+            ("struct", tb.PUBLICATION),
+        )
+        assert "snoop:k1" in pub.key_vals
+
+        # doNotPublishValue=true withholds values (hash-only dump)
+        pub = _call_ok(
+            port,
+            "getKvStoreKeyValsFiltered",
+            14,
+            tb.encode_struct(
+                filter_args,
+                {
+                    "filter": {
+                        "keys": ["snoop:"],
+                        "do_not_publish_value": True,
+                    }
+                },
+            ),
+            ("struct", tb.PUBLICATION),
+        )
+        assert pub.key_vals["snoop:k1"].value is None
+        assert pub.key_vals["snoop:k1"].hash != 0
+
         # getKvStoreHashFiltered(1: KeyDumpParams) — hash dump: no values
-        hash_args = tb.encode_struct(
-            tb.StructSpec(
-                "args",
-                None,
-                (tb.Field(1, "filter", ("struct", tb.KEY_DUMP_PARAMS)),),
-            ),
-            {"filter": {"keys": ["snoop:"]}},
+        pub = _call_ok(
+            port,
+            "getKvStoreHashFiltered",
+            10,
+            tb.encode_struct(filter_args, {"filter": {"keys": ["snoop:"]}}),
+            ("struct", tb.PUBLICATION),
         )
-        name, mtype, _s_, r = _thrift_call(
-            shim_srv.port, "getKvStoreHashFiltered", 10, hash_args
-        )
-        assert mtype == tb.MSG_REPLY
-        pub = tb.read_struct(
-            r,
-            tb.StructSpec(
-                "result",
-                None,
-                (tb.Field(0, "success", ("struct", tb.PUBLICATION)),),
-            ),
-        )["success"]
         assert pub.key_vals["snoop:k1"].value is None
         assert pub.key_vals["snoop:k1"].hash != 0
 
@@ -380,59 +392,32 @@ class TestShimExchange:
         daemon.kvstore.set_key_vals(
             "0", {"snoop:ttl": Value(1, "shimd", b"t", 30000, 1)}
         )
-        name, mtype, _s_, r = _thrift_call(
-            shim_srv.port, "getKvStoreKeyValsFilteredArea", 12,
+        pub = _call_ok(
+            port,
+            "getKvStoreKeyValsFilteredArea",
+            12,
             tb.encode_struct(
-                tb.StructSpec(
-                    "args",
-                    None,
-                    (
-                        tb.Field(
-                            1, "filter", ("struct", tb.KEY_DUMP_PARAMS)
-                        ),
-                        tb.Field(2, "area", tb.T_STRING),
-                    ),
-                ),
+                filter_args,
                 {"filter": {"keys": ["snoop:ttl"]}, "area": "0"},
             ),
+            ("struct", tb.PUBLICATION),
         )
-        assert mtype == tb.MSG_REPLY
-        pub = tb.read_struct(
-            r,
-            tb.StructSpec(
-                "result",
-                None,
-                (tb.Field(0, "success", ("struct", tb.PUBLICATION)),),
-            ),
-        )["success"]
         assert 0 < pub.key_vals["snoop:ttl"].ttl_ms < 30000
 
         # getKvStorePeersArea(1: area) -> map<string, PeerSpec>
-        name, mtype, _s_, r = _thrift_call(
-            shim_srv.port, "getKvStorePeersArea", 11,
+        peers = _call_ok(
+            port,
+            "getKvStorePeersArea",
+            11,
             tb.encode_struct(
                 tb.StructSpec(
                     "args", None, (tb.Field(1, "area", tb.T_STRING),)
                 ),
                 {"area": "0"},
             ),
+            ("map", tb.T_STRING, ("struct", tb.PEER_SPEC)),
+            dec=lambda m: {k.decode(): v for k, v in m.items()},
         )
-        assert mtype == tb.MSG_REPLY
-        peers = tb.read_struct(
-            r,
-            tb.StructSpec(
-                "result",
-                None,
-                (
-                    tb.Field(
-                        0,
-                        "success",
-                        ("map", tb.T_STRING, ("struct", tb.PEER_SPEC)),
-                        dec=lambda m: {k.decode(): v for k, v in m.items()},
-                    ),
-                ),
-            ),
-        )["success"]
         assert peers == {}  # single-node daemon: no peers
 
 
