@@ -39,8 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .sssp import INF32
-from .banded import INF16, WBIG16
+from .sssp import INF16, INF32, clamp_metric_u16
 
 
 class OutEll(NamedTuple):
@@ -134,7 +133,7 @@ def ecmp_bitmap_from_reverse_dist(
         ok = (eidk >= 0) & jnp.take(edge_up, jnp.maximum(eidk, 0))
         w = jnp.take(edge_metric, jnp.maximum(eidk, 0))  # [N]
         if u16:
-            w = jnp.minimum(w, jnp.int32(WBIG16)).astype(jnp.uint16)
+            w = clamp_metric_u16(w)
         nbr = out.nbr[:, k]
         d_nbr = jnp.take(drev_T, nbr, axis=0)  # [N, P]
         nbr_ov = jnp.take(node_overloaded, nbr)  # [N]

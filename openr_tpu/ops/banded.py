@@ -54,15 +54,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .sssp import INF32
+# the uint16 distance-mode constants and helpers are shared with the ELL
+# kernel and live in ops.sssp (re-exported here for existing importers)
+from .sssp import (
+    INF16,
+    INF32,
+    WBIG16,
+    sp_dag_mask16_from_T,
+    u16_dist_to_i32,
+    u16_saturation_verdict,
+)
 
 # band-weight infinity: saturating compose keeps weights <= WBIG and
 # INF32 + WBIG < 2^31, so no int32 overflow anywhere
 WBIG = jnp.int32(1 << 28)
-# uint16 mode: dist in [0, INF16], weights <= WBIG16; INF16 + WBIG16
-# < 2^16 so the adds never wrap
-INF16 = jnp.uint32(40000).astype(jnp.uint16)
-WBIG16 = jnp.uint32(20000).astype(jnp.uint16)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -454,37 +459,24 @@ def spf_forward_banded(
         chord_mode=chord_mode,
     )
     dist16 = None
-    if small_dist is True:
-        # saturation guard: with every edge weight < WBIG16, any true
-        # distance that would overflow INF16 forces SOME node into the
-        # finite band [WBIG16, INF16) first; a clean margin certifies no
-        # distance saturated.  (Callers must already exclude metrics
-        # >= WBIG16 — those edges would be masked as down here.)
-        fin_max = jnp.max(jnp.where(dist < INF16, dist, jnp.uint16(0)))
-        converged = converged & (fin_max < WBIG16)
+    if small_dist:
+        # callers must already exclude metrics >= WBIG16 — those edges
+        # would be masked as down here (pick_small_dist gate)
+        converged = u16_saturation_verdict(dist, converged)
         dist16 = dist
         if raw_u16 and not want_dag:
             return dist16.T, None, converged
-        dist = jnp.where(dist >= INF16, INF32, dist.astype(jnp.int32))
+        dist = u16_dist_to_i32(dist)
     if not want_dag:
         return dist.T, None, converged
     allowed_T = make_relax_allowed_T(
         sources, edge_src, edge_up, node_overloaded, extra_T
     )
     if dist16 is not None:
-        # DAG membership in the uint16 domain: the gathers move half the
-        # bytes (the dominant cost of the extraction at large S).  Valid
-        # because finite d + metric < 2^16 (both < WBIG16-bounded) and
-        # saturated entries are excluded by the d_u < INF16 guard.
-        m16 = jnp.minimum(metric, jnp.int32(WBIG16)).astype(jnp.uint16)
-        d_u = jnp.take(dist16, edge_src, axis=0)  # [E, S] uint16
-        d_v = jnp.take(dist16, edge_dst, axis=0)
-        dag_T = (
-            allowed_T
-            & (d_u < INF16)
-            & (d_u + m16[:, None] == d_v)
+        dag = sp_dag_mask16_from_T(
+            dist16, edge_src, edge_dst, metric, allowed_T
         )
-        return dist.T, dag_T.T, converged
+        return dist.T, dag, converged
     dag = sp_dag_mask_from_T(dist, edge_src, edge_dst, metric, allowed_T)
     return dist.T, dag, converged
 
@@ -564,8 +556,10 @@ class SpfRunner:
         # small_allowed latches off on a saturation fallback; the metric
         # bound is re-checked per run_once because the mirror refreshes
         # edge_metric IN PLACE (csr.refresh) and an oversized metric must
-        # never reach the uint16 kernel (it would be masked as down)
-        self.small_allowed = bg is not None
+        # never reach the uint16 kernel (it would be masked as down).
+        # Round 5: the ELL kernel gained the uint16 mode too, so both
+        # paths start eligible.
+        self.small_allowed = True
         # optional device-resident pin of the runtime arrays (stage())
         self._staged = None
 
@@ -775,4 +769,6 @@ class SpfRunner:
                 else jnp.asarray(extra_edge_mask)
             ),
             want_dag=want_dag,
+            small_dist=small,
+            raw_u16=raw_u16,
         )

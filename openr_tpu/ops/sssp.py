@@ -41,6 +41,51 @@ import numpy as np
 
 INF32 = jnp.int32(1 << 30)
 
+# uint16 distance mode, shared by the ELL and banded kernels: dist in
+# [0, INF16], weights clamped to WBIG16 so INF16 + WBIG16 < 2^16 and the
+# relax adds never wrap.  pick_small_dist (ops.banded) gates entry; the
+# saturation verdict below certifies no true distance overflowed.
+INF16 = jnp.uint32(40000).astype(jnp.uint16)
+WBIG16 = jnp.uint32(20000).astype(jnp.uint16)
+
+
+def clamp_metric_u16(metric: jax.Array) -> jax.Array:
+    """Clamp BEFORE the cast: an oversized metric must saturate to the
+    band infinity, never wrap (a racing in-place metric refresh must stay
+    safe; the int32 retry path restores exactness)."""
+    return jnp.minimum(metric, jnp.int32(WBIG16)).astype(jnp.uint16)
+
+
+def u16_saturation_verdict(dist16: jax.Array, converged: jax.Array) -> jax.Array:
+    """AND the convergence verdict with the saturation guard: with every
+    weight < WBIG16, any true distance that would overflow INF16 forces
+    SOME entry into the finite band [WBIG16, INF16) first, so a clean
+    margin certifies no distance saturated."""
+    fin_max = jnp.max(jnp.where(dist16 < INF16, dist16, jnp.uint16(0)))
+    return converged & (fin_max < WBIG16)
+
+
+def u16_dist_to_i32(dist16: jax.Array) -> jax.Array:
+    """uint16/INF16 domain -> the int32/INF32 output contract."""
+    return jnp.where(dist16 >= INF16, INF32, dist16.astype(jnp.int32))
+
+
+def sp_dag_mask16_from_T(
+    dist16_old_T: jax.Array,  # [N_cap, S] uint16 — ORIGINAL node ids
+    edge_src: jax.Array,
+    edge_dst: jax.Array,
+    edge_metric: jax.Array,  # [E] int32 (clamped here)
+    allowed_T: jax.Array,  # [E, S]
+) -> jax.Array:
+    """SP-DAG membership evaluated in the uint16 domain: the [E, S]
+    gathers are the extraction's dominant cost at large S, and they move
+    half the bytes here.  Valid because finite d + clamped metric < 2^16
+    and saturated entries are excluded by the d_u < INF16 guard."""
+    m16 = clamp_metric_u16(edge_metric)
+    d_u = jnp.take(dist16_old_T, edge_src, axis=0)  # [E, S]
+    d_v = jnp.take(dist16_old_T, edge_dst, axis=0)
+    return (allowed_T & (d_u < INF16) & (d_u + m16[:, None] == d_v)).T
+
 
 @jax.jit
 def batched_sssp(
@@ -240,7 +285,12 @@ def build_ell(
     return EllGraph(tuple(buckets), new_of_old, old_of_new)
 
 
-def make_dist0_T(sources: jax.Array, new_of_old: jax.Array, n_cap: int) -> jax.Array:
+def make_dist0_T(
+    sources: jax.Array,
+    new_of_old: jax.Array,
+    n_cap: int,
+    small_dist: bool = False,
+) -> jax.Array:
     """Transposed-permuted dist0: [N_cap, S] with 0 at each column's source.
 
     Built as a dense compare, NOT a scatter: scatter ops knock the TPU
@@ -249,6 +299,8 @@ def make_dist0_T(sources: jax.Array, new_of_old: jax.Array, n_cap: int) -> jax.A
     production path must be scatter-free end to end."""
     rows = jnp.take(new_of_old, sources)  # [S]
     is_src = jnp.arange(n_cap, dtype=jnp.int32)[:, None] == rows[None, :]
+    if small_dist:
+        return jnp.where(is_src, jnp.uint16(0), INF16)
     return jnp.where(is_src, jnp.int32(0), INF32)
 
 
@@ -288,8 +340,15 @@ def batched_sssp_ell(
     what-if) on top of the up/transit conditions.
     `check_every` batches the convergence reduction over that many relax
     sweeps (saves two [N, S] passes per skipped check on large problems).
+
+    Distances run in the dtype of `dist0_T`: uint16 (INF16 sentinel,
+    weights clamped to WBIG16 so adds never wrap — round-5, same
+    discipline as ops.banded) halves every gather's bytes; callers gate
+    on pick_small_dist and verify the saturation guard.
     """
     n_cap = dist0_T.shape[0]
+    small = dist0_T.dtype == jnp.uint16
+    inf = INF16 if small else INF32
 
     # loop-invariant slot permissions, possibly runtime-derived
     overloaded_new = (
@@ -317,6 +376,8 @@ def batched_sssp_ell(
             if edge_metric is None
             else jnp.take(edge_metric, jnp.maximum(bk.edge_id, 0))
         )
+        if small:
+            w = clamp_metric_u16(w)
         slot_ok.append(ok)
         slot_transit.append(transit)
         slot_w.append(w)
@@ -352,11 +413,11 @@ def batched_sssp_ell(
                 if slot_allowed[b] is not None:
                     allow &= slot_allowed[b][:, j]
                 metric_j = (
-                    jnp.int32(1)
+                    (jnp.uint16(1) if small else jnp.int32(1))
                     if unit_metric
                     else slot_w[b][:, j][:, None]
                 )
-                cand = jnp.where(allow & (d_u < INF32), d_u + metric_j, INF32)
+                cand = jnp.where(allow & (d_u < inf), d_u + metric_j, inf)
                 acc = jnp.minimum(acc, cand)
             parts.append(acc)
             lo += r
@@ -737,7 +798,14 @@ def spf_forward_full_packed(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("use_link_metric", "n_sweeps", "want_dag")
+    jax.jit,
+    static_argnames=(
+        "use_link_metric",
+        "n_sweeps",
+        "want_dag",
+        "small_dist",
+        "raw_u16",
+    ),
 )
 def spf_forward_ell_sweeps(
     sources: jax.Array,
@@ -751,12 +819,21 @@ def spf_forward_ell_sweeps(
     use_link_metric: bool = True,
     extra_edge_mask: Optional[jax.Array] = None,
     want_dag: bool = True,
+    small_dist: bool = False,
+    raw_u16: bool = False,
 ):
     """Fixed-sweep ELL forward: (dist [S, N_cap], dag, converged) — the
     production execution discipline (no data-dependent while_loop, which
     costs a host sync per iteration on latency-bound transports) exposed
     for dist+dag callers: bench rows and batch KSP/what-if runs on
-    topologies without band structure (see ops.banded for the rest)."""
+    topologies without band structure (see ops.banded for the rest).
+
+    ``small_dist`` runs the relax AND the DAG extraction in uint16
+    (half the gather bytes; callers gate on pick_small_dist); the
+    in-kernel saturation guard certifies no distance overflowed exactly
+    as in ops.banded.  ``raw_u16`` additionally returns the raw uint16
+    distances (INF16 sentinel) when want_dag=False — consumers key on
+    dtype."""
     n_cap = node_overloaded.shape[0]
     extra_T = None
     if extra_edge_mask is not None:
@@ -769,7 +846,7 @@ def spf_forward_ell_sweeps(
         sources, edge_src, edge_up, node_overloaded, extra_T
     )
     dist_T, converged = batched_sssp_ell(
-        make_dist0_T(sources, ell.new_of_old, n_cap),
+        make_dist0_T(sources, ell.new_of_old, n_cap, small_dist=small_dist),
         ell,
         row_allowed_T=allowed_T if extra_edge_mask is not None else None,
         unit_metric=not use_link_metric,
@@ -779,9 +856,21 @@ def spf_forward_ell_sweeps(
         n_sweeps=n_sweeps,
     )
     dist_old_T = ell_dist_to_old_T(dist_T, ell)
+    dist16_old_T = None
+    if small_dist:
+        converged = u16_saturation_verdict(dist_old_T, converged)
+        dist16_old_T = dist_old_T
+        if raw_u16 and not want_dag:
+            return dist_old_T.T, None, converged
+        dist_old_T = u16_dist_to_i32(dist_old_T)
     if not want_dag:
         return dist_old_T.T, None, converged
     metric = edge_metric if use_link_metric else jnp.ones_like(edge_metric)
+    if dist16_old_T is not None:
+        dag = sp_dag_mask16_from_T(
+            dist16_old_T, edge_src, edge_dst, metric, allowed_T
+        )
+        return dist_old_T.T, dag, converged
     dag = sp_dag_mask_from_T(dist_old_T, edge_src, edge_dst, metric, allowed_T)
     return dist_old_T.T, dag, converged
 

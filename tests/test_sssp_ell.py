@@ -258,6 +258,49 @@ class TestEllKernelParity:
         assert np.asarray(dist3)[0, csr.node_id["c"]] == 10
         assert np.asarray(dist3)[0, csr.node_id["b"]] == 1  # still reachable
 
+    def test_uint16_mode_matches_int32(self):
+        """Round-5 uint16 ELL mode (half the gather bytes): distances,
+        DAG, raw_u16 output, and the saturation fallback must line up
+        with the int32 path (ops.sssp spf_forward_ell_sweeps)."""
+        ls = build(fat_tree_topology(4))
+        csr = CsrTopology.from_link_state(ls)
+        src_ids = np.arange(csr.n_nodes, dtype=np.int32)
+        kw = dict(
+            ell=csr.ell,
+            edge_src=csr.edge_src,
+            edge_dst=csr.edge_dst,
+            edge_metric=csr.edge_metric,
+            edge_up=csr.edge_up,
+            node_overloaded=csr.node_overloaded,
+            n_sweeps=16,
+        )
+        d32, g32, ok32 = ops.spf_forward_ell_sweeps(src_ids, **kw)
+        d16, g16, ok16 = ops.spf_forward_ell_sweeps(
+            src_ids, small_dist=True, **kw
+        )
+        assert bool(ok32) and bool(ok16)
+        np.testing.assert_array_equal(np.asarray(d16), np.asarray(d32))
+        np.testing.assert_array_equal(np.asarray(g16), np.asarray(g32))
+        # raw_u16: uint16 dtype out, INF16 sentinel for padding rows
+        draw, _, okr = ops.spf_forward_ell_sweeps(
+            src_ids, small_dist=True, raw_u16=True, want_dag=False, **kw
+        )
+        assert np.asarray(draw).dtype == np.uint16
+        np.testing.assert_array_equal(
+            np.where(
+                np.asarray(draw) >= 40000,
+                np.int32(ops.INF32),
+                np.asarray(draw).astype(np.int32),
+            ),
+            np.asarray(d32),
+        )
+        # runner integration: fat-tree (no bands) engages uint16 via the
+        # ELL branch, and the saturation guard falls back on big metrics
+        assert csr.banded is None
+        assert csr.runner.small_dist
+        csr.edge_metric[: csr.n_edges] = 10_000
+        assert not csr.runner.small_dist
+
     def test_check_every_batching(self):
         """check_every > 1 must not change the fixed point."""
         import jax.numpy as jnp
