@@ -637,6 +637,84 @@ class TestBgpMetricVectorKsp2:
         assert PFX not in db.unicast_routes
 
 
+class TestBgpIgpMetricSequence:
+    """Ancestor: BGPRedistribution.IgpMetric (DecisionTest.cpp:973-1137):
+    two BGP advertisers whose vectors differ only in a tie-breaker entity
+    both stay selected, so the route's next-hop set follows pure IGP
+    distance through metric changes, a link drain, and the undrain."""
+
+    def _ps(self):
+        # same vector on priority-1, tie-breaker entity differs -> both
+        # advertisers retained (TIE_WINNER orders, does not exclude)
+        def entry(tb_value: int) -> PrefixEntry:
+            return PrefixEntry(
+                prefix=PFX,
+                type=PrefixType.BGP,
+                mv=MetricVector(
+                    metrics=[
+                        MetricEntity(
+                            type=1, priority=2, metric=[7]
+                        ),
+                        MetricEntity(
+                            type=2,
+                            priority=1,
+                            is_best_path_tie_breaker=True,
+                            metric=[tb_value],
+                        ),
+                    ]
+                ),
+            )
+
+        return prefix_state_with(
+            ("2", "0", entry(1)),
+            ("3", "0", entry(100)),
+        )
+
+    @staticmethod
+    def _y(m13=10, drain_12=False):
+        a12 = adj("1", "2")
+        a12.is_overloaded = drain_12
+        a21 = adj("2", "1")
+        a21.is_overloaded = drain_12
+        return build_link_state(
+            {
+                "1": [a12, adj("1", "3", metric=m13)],
+                "2": [a21],
+                "3": [adj("3", "1", metric=m13)],
+            },
+            labels={"1": 101, "2": 102, "3": 103},
+        )
+
+    def test_equal_igp_distance_ecmps_both(self):
+        db = routes("1", {"0": self._y()}, self._ps())
+        assert nh_names(db.unicast_routes[PFX]) == {"2", "3"}
+
+    def test_costlier_advertiser_dropped(self):
+        # cost toward 3 raised to 20 -> only 2 remains (IgpMetric step 2)
+        db = routes("1", {"0": self._y(m13=20)}, self._ps())
+        assert nh_names(db.unicast_routes[PFX]) == {"2"}
+
+    def test_drained_nearest_falls_back_to_far(self):
+        # link to 2 drained (both directions) -> 3 serves despite cost 20
+        db = routes("1", {"0": self._y(m13=20, drain_12=True)}, self._ps())
+        assert nh_names(db.unicast_routes[PFX]) == {"3"}
+        # node 2's loopback-ish reachability is gone with the link
+        assert 102 not in db.mpls_routes
+
+    def test_undrain_restores_ecmp_at_equal_cost(self):
+        # undrain with both legs at 20 -> ECMP again (IgpMetric step 5)
+        ls = build_link_state(
+            {
+                "1": [adj("1", "2", metric=20), adj("1", "3", metric=20)],
+                "2": [adj("2", "1", metric=20)],
+                "3": [adj("3", "1", metric=20)],
+            },
+            labels={"1": 101, "2": 102, "3": 103},
+        )
+        db = routes("1", {"0": ls}, self._ps())
+        assert nh_names(db.unicast_routes[PFX]) == {"2", "3"}
+
+
 class TestMultiAreaRedistribution:
     """Ancestor: DecisionTestFixture.MultiAreaBestPathCalculation
     (DecisionTest.cpp:5420) + SelfReditributePrefixPublication (:5563)."""

@@ -301,6 +301,48 @@ class TestEllKernelParity:
         csr.edge_metric[: csr.n_edges] = 10_000
         assert not csr.runner.small_dist
 
+    def test_uint16_saturation_falls_back_to_int32(self):
+        """A topology that passes the pick_small_dist gate (all metrics
+        < WBIG16/4) but whose true distances exceed WBIG16 must trip the
+        ELL saturation verdict, latch small_allowed off through the
+        runner's adapt loop, and still return exact int32 distances."""
+        # 7-node chain (< 64 nodes -> no bands -> ELL path), metric 4000:
+        # far-end distance 24000 > WBIG16=20000, every metric < 5000
+        n = 7
+        dbs = []
+        for i in range(n):
+            adjs = []
+            if i > 0:
+                adjs.append(adj(f"c{i}", f"c{i-1}", metric=4000))
+            if i + 1 < n:
+                adjs.append(adj(f"c{i}", f"c{i+1}", metric=4000))
+            dbs.append(adj_db(f"c{i}", adjs))
+        ls = build(dbs)
+        csr = CsrTopology.from_link_state(ls)
+        assert csr.banded is None
+        r = csr.runner
+        assert r.small_dist  # eligible by the metric gate...
+        src = np.asarray([csr.node_id["c0"]], dtype=np.int32)
+        # ...but the direct uint16 run must FAIL the saturation verdict
+        _, _, ok16 = ops.spf_forward_ell_sweeps(
+            src,
+            csr.ell,
+            csr.edge_src,
+            csr.edge_dst,
+            csr.edge_metric,
+            csr.edge_up,
+            csr.node_overloaded,
+            n_sweeps=16,
+            small_dist=True,
+            want_dag=False,
+        )
+        assert not bool(ok16)
+        # the adaptive runner falls back to int32 and gets exact results
+        dist, _ = r.forward(src, want_dag=False)
+        assert not r.small_allowed  # latched off by the saturation retry
+        far = csr.node_id[f"c{n-1}"]
+        assert int(dist[0, far]) == 4000 * (n - 1)
+
     def test_check_every_batching(self):
         """check_every > 1 must not change the fixed point."""
         import jax.numpy as jnp
