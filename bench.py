@@ -265,7 +265,7 @@ def bench_allsrc_full_wan100k(topo, n_prefixes: int = 1024) -> dict:
     input is a single device round, not ceil(N/1024)=98 tiled dispatches
     of an output nobody consumes (r3: 197.7 s end-to-end).
 
-    Output ([P,N] int32 dist + [N,P,W] uint32 bitmaps, ~800 MB at
+    Output ([N,P] dist + [N,P,W] uint32 bitmaps, ~600 MB at
     P=1024) stays on device; each router's route build reads its own
     row, exactly as the per-tile distances did before."""
     import jax
@@ -314,12 +314,13 @@ def bench_allsrc_full_wan100k(topo, n_prefixes: int = 1024) -> dict:
         sample_v,
         want_dist=True,
     )
-    from openr_tpu.decision.fleet import _col_i32
+    from openr_tpu.decision.fleet import _row_i32
 
-    # raw uint16 product -> the int32/INF32 oracle domain
-    dist_np = _col_i32(np.asarray(dist))
+    # raw uint16 product -> the int32/INF32 oracle domain ([N*, P]
+    # native layout: row v = dist(v -> every dest))
+    dist_np = _row_i32(np.asarray(dist))
     for i, v in enumerate(sample_v):
-        np.testing.assert_array_equal(dist_np[:, v], cdist[i, dests])
+        np.testing.assert_array_equal(dist_np[v], cdist[i, dests])
 
     rep_counter = [0]
 
@@ -371,18 +372,23 @@ def bench_allsrc_full_wan100k(topo, n_prefixes: int = 1024) -> dict:
     ov_d = jnp.asarray(topo.node_overloaded)
     t_one = _min_t(
         lambda i: runner.run_once(
-            np.roll(dests, i), 1, want_dag=False, raw_u16=True
+            np.roll(dests, i), 1, want_dag=False, raw_u16=True,
+            transpose=False,
         )
     )
     t_kernel = _min_t(
         lambda i: runner.run_once(
-            np.roll(dests, i), hint, want_dag=False, raw_u16=True
+            np.roll(dests, i), hint, want_dag=False, raw_u16=True,
+            transpose=False,
         )
     )
     per_sweep = max(t_kernel - t_one, 0.0) / max(hint - 1, 1)
     t_tax = max(t_one - 2 * per_sweep, 0.0)
-    # raw uint16 staging matches the production bitmap input dtype
-    dist_k, _, _ = runner.run_once(dests, hint, want_dag=False, raw_u16=True)
+    # raw uint16 staging matches the production bitmap input dtype and
+    # the [N*, P] native layout
+    dist_k, _, _ = runner.run_once(
+        dests, hint, want_dag=False, raw_u16=True, transpose=False
+    )
     # pre-stage the rolled distance inputs OUTSIDE the timed window: an
     # in-window jnp.roll would add a second dispatch + a full-matrix
     # copy to every sample and masquerade as bitmap cost

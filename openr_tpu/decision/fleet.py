@@ -9,7 +9,7 @@ reference's equivalent consumer is the per-prefix route build
 node distances) and the any-node ctrl query
 (openr/decision/Decision.cpp:1510-1530, getDecisionRouteDb).
 
-Why the product suffices: the reverse distances dist[p, v] == dist(v -> p)
+Why the product suffices: the reverse distances dist[v, p] == dist(v -> p)
 cover EVERY router v, so for any router `me` the route build has
 - reachability:  dist(me -> advertiser) < INF
 - best-metric:   min over advertisers of dist(me -> advertiser)
@@ -17,7 +17,7 @@ cover EVERY router v, so for any router `me` the route build has
                  metric(l) + dist(u -> p) == dist(me -> p)
                  (openr/decision/Decision.cpp:1296-1300), with the drain
                  exception (overloaded u only as the destination itself,
-                 dist(u -> p) == 0) — all reads of the same [P, N] matrix.
+                 dist(u -> p) == 0) — all reads of the same [N, P] matrix.
 The fused [N, P, W] bitmap is the device-side fleet-wide evaluation of the
 same condition (ops.allsources.ecmp_bitmap_from_reverse_dist); the host
 hooks in SpfSolver evaluate it per link so parallel links keep their
@@ -45,13 +45,13 @@ INF32 = 1 << 30
 INF16 = 40000
 
 
-def _col_i32(col: np.ndarray) -> np.ndarray:
-    """Normalize a fetched distance column to the int32/INF32 contract —
+def _row_i32(row: np.ndarray) -> np.ndarray:
+    """Normalize a fetched distance row to the int32/INF32 contract —
     the device product runs raw uint16 (INF16 sentinel) when the banded
     kernel's small-distance mode engages (ops.banded raw_u16)."""
-    if col.dtype == np.uint16:
-        return np.where(col >= INF16, INF32, col.astype(np.int32))
-    return col
+    if row.dtype == np.uint16:
+        return np.where(row >= INF16, INF32, row.astype(np.int32))
+    return row
 
 log = logging.getLogger(__name__)
 
@@ -119,10 +119,11 @@ class FleetRouteView:
         self._node_id = dict(csr.node_id)
         # runtime-state snapshot for the host-side per-link checks
         self._overloaded = csr.node_overloaded.copy()
-        self._dist_dev = None  # jax [P, N*]
+        self._dist_dev = None  # jax [N*, P] — row per router (native
+        #   kernel layout; a router's fetch is one contiguous row)
         self._bitmap_dev = None  # jax [N, P, W]
         self._out = None  # ops.allsources.OutEll
-        self._cols: dict[int, np.ndarray] = {}  # node id -> [P] int32
+        self._rows: dict[int, np.ndarray] = {}  # node id -> [P] int32
         self.converged = False
         self.sweep_hint: Optional[int] = None
 
@@ -170,38 +171,38 @@ class FleetRouteView:
     def is_dest(self, node: str) -> bool:
         return node in self.p_index
 
-    def _col(self, node: str) -> np.ndarray:
+    def _row(self, node: str) -> np.ndarray:
         """dist(node -> every dest), [P] int32; fetched lazily and cached
-        (one device gather per new node — a ctrl query touches only the
-        queried router and its neighbors)."""
+        (one device row fetch per new node — a ctrl query touches only
+        the queried router and its neighbors)."""
         i = self._node_id[node]
-        hit = self._cols.get(i)
+        hit = self._rows.get(i)
         if hit is None:
-            hit = _col_i32(np.asarray(self._dist_dev[:, i]))
-            self._cols[i] = hit
+            hit = _row_i32(np.asarray(self._dist_dev[i]))
+            self._rows[i] = hit
         return hit
 
-    def prefetch_cols(self, nodes: list[str]) -> None:
-        """Fetch many columns in one device gather (fleet dumps)."""
+    def prefetch_rows(self, nodes: list[str]) -> None:
+        """Fetch many routers' rows in one device gather (fleet dumps)."""
         import jax.numpy as jnp
 
         ids = [self._node_id[n] for n in nodes if n in self._node_id]
-        missing = [i for i in ids if i not in self._cols]
+        missing = [i for i in ids if i not in self._rows]
         if not missing:
             return
-        cols = _col_i32(
+        rows = _row_i32(
             np.asarray(
                 jnp.take(
-                    self._dist_dev, jnp.asarray(missing, jnp.int32), axis=1
+                    self._dist_dev, jnp.asarray(missing, jnp.int32), axis=0
                 )
             )
         )
         for k, i in enumerate(missing):
-            self._cols[i] = cols[:, k]
+            self._rows[i] = rows[k]
 
     def dist(self, node: str, dest: str) -> int:
         """dist(node -> dest); INF32 when unreachable."""
-        d = self._col(node)[self.p_index[dest]]
+        d = self._row(node)[self.p_index[dest]]
         return int(d)
 
     def reachable(self, node: str, dest: str) -> bool:

@@ -1317,7 +1317,7 @@ class SpfSolver:
             wanted = {node}
             for link in ls.links_from_node(node):
                 wanted.add(link.other_node_name(node))
-            view.prefetch_cols(sorted(wanted))
+            view.prefetch_rows(sorted(wanted))
         return self.build_route_db(
             area_link_states,
             prefix_state,
@@ -1366,7 +1366,7 @@ class SpfSolver:
                 wanted.add(n)
                 for link in ls.links_from_node(n):
                     wanted.add(link.other_node_name(n))
-            view.prefetch_cols(sorted(wanted))
+            view.prefetch_rows(sorted(wanted))
         out: dict[str, DecisionRouteDb] = {}
         for node in nodes:
             db = self.build_route_db(

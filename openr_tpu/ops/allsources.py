@@ -96,8 +96,11 @@ def build_out_ell(
 
 @functools.partial(jax.jit, static_argnames=("n_words",))
 def ecmp_bitmap_from_reverse_dist(
-    drev: jax.Array,  # [P, N*] int32 — reverse-SSSP distances (dist(v->p));
-    #   N* is n_nodes (banded kernel) or node_capacity (ELL fallback)
+    drev: jax.Array,  # [N*, P] — reverse-SSSP distances (drev[v, p] =
+    #   dist(v->p)); N* is n_nodes (banded kernel) or node_capacity (ELL
+    #   fallback).  Native kernel layout — no transpose on either side
+    #   (round-5: the [P, N] orientation cost two 200MB-scale transposes
+    #   per product round)
     out: OutEll,
     edge_metric: jax.Array,  # [E_cap] int32
     edge_up: jax.Array,  # [E_cap] bool
@@ -117,10 +120,8 @@ def ecmp_bitmap_from_reverse_dist(
     itself — the same own-source/destination exception the relax kernels
     encode, here as d(u,p) == 0."""
     n, k_pad = out.nbr.shape
-    drev_T = drev.T  # [N*, P]
-    p_dim = drev.shape[0]
-    bitmap = jnp.zeros((n, p_dim, n_words), dtype=jnp.uint32)
-    d_self = drev_T[:n]  # [N, P]
+    p_dim = drev.shape[1]
+    d_self = drev[:n]  # [N, P]
     # uint16 domain (raw banded distances, INF16 sentinel): the gathers
     # move half the bytes.  Safe because finite d < INF16=40000 and
     # clamped metric <= WBIG16=20000 never wrap in uint16, and a finite
@@ -128,21 +129,45 @@ def ecmp_bitmap_from_reverse_dist(
     # d_nbr + w == d_self compare never matches a saturated self).
     u16 = drev.dtype == jnp.uint16
     inf = INF16 if u16 else INF32
-    for k in range(k_pad):
+
+    def slot_on(k):
+        """[N, P] bool: out-slot k of every router is an ECMP hop."""
         eidk = out.eid[:, k]
         ok = (eidk >= 0) & jnp.take(edge_up, jnp.maximum(eidk, 0))
         w = jnp.take(edge_metric, jnp.maximum(eidk, 0))  # [N]
         if u16:
             w = clamp_metric_u16(w)
         nbr = out.nbr[:, k]
-        d_nbr = jnp.take(drev_T, nbr, axis=0)  # [N, P]
+        d_nbr = jnp.take(drev, nbr, axis=0)  # [N, P]
         nbr_ov = jnp.take(node_overloaded, nbr)  # [N]
-        on = (
+        return (
             ok[:, None]
             & (d_nbr < inf)
             & (d_nbr + w[:, None] == d_self)
             & (~nbr_ov[:, None] | (d_nbr == 0))
-        )  # [N, P]
+        )
+
+    if n_words == 1:
+        # single-word fast path (any topology with <=32 unique
+        # out-neighbors per node): a flat uint32 OR chain, no [N, P, W]
+        # broadcast scaffolding per slot
+        bitmap2d = jnp.zeros((n, p_dim), dtype=jnp.uint32)
+        for k in range(k_pad):
+            slot = out.slot[:, k]
+            bit = jnp.where(
+                slot >= 0,
+                jnp.uint32(1)
+                << (jnp.maximum(slot, 0) % 32).astype(jnp.uint32),
+                jnp.uint32(0),
+            )  # [N]
+            bitmap2d = bitmap2d | jnp.where(
+                slot_on(k), bit[:, None], jnp.uint32(0)
+            )
+        return bitmap2d[:, :, None]
+
+    bitmap = jnp.zeros((n, p_dim, n_words), dtype=jnp.uint32)
+    for k in range(k_pad):
+        on = slot_on(k)
         slot = out.slot[:, k]
         bit = jnp.where(
             slot >= 0,
@@ -172,11 +197,14 @@ def reduced_all_sources(
     fused: bool = False,
 ):
     """Fleet-wide route-building input in one device round:
-    (dist [P, N*] jax — dist[p, v] = dist(v -> p), nh_bitmap
+    (dist [N*, P] jax — dist[v, p] = dist(v -> p), nh_bitmap
     [N, P, W] uint32 jax, converged bool).  dist is raw uint16 with the
     INF16 sentinel when the banded kernel's small-distance mode engages
     (half the bitmap-gather bytes), int32/INF32 otherwise — consumers
-    key on dtype (decision.fleet._col_i32).
+    key on dtype (decision.fleet._row_i32).  The [N*, P] orientation is
+    the relax kernel's NATIVE layout (round-5: the former [P, N*]
+    contract paid two 200MB-scale transposes per product round), and it
+    is also what consumers want — a router's row fetch is contiguous.
 
     `reverse_runner` is an ops.banded.SpfRunner over the REVERSED edge
     arrays (benchmarks.synthetic.reversed_topology / csr mirror).  With
@@ -215,7 +243,7 @@ def reduced_all_sources(
         # raw uint16 distances when the banded kernel runs small: the
         # bitmap pass gathers half the bytes (ecmp_bitmap keys on dtype)
         dist, _, ok = reverse_runner.run_once(
-            dest_ids, sweeps, want_dag=False, raw_u16=True
+            dest_ids, sweeps, want_dag=False, raw_u16=True, transpose=False
         )
         return dist, None, ok
 
@@ -275,8 +303,8 @@ def _fused_product_banded(
     verdict the caller re-runs, wasting only the cheap bitmap pass."""
     from .banded import spf_forward_banded
 
-    # spf_forward_banded returns dist [S, N] == the [P, N*] drev layout
-    # (raw uint16 when small — the bitmap pass then gathers half bytes)
+    # native [N, S] == the [N*, P] drev layout, transpose-free on both
+    # sides (raw uint16 when small — the bitmap pass gathers half bytes)
     dist, _, ok = spf_forward_banded(
         dest_ids,
         bg,
@@ -292,6 +320,7 @@ def _fused_product_banded(
         want_dag=False,
         chord_mode=chord_mode,
         raw_u16=True,
+        transpose=False,
     )
     bitmap = ecmp_bitmap_from_reverse_dist(
         dist, out, f_edge_metric, f_edge_up, node_overloaded, n_words

@@ -395,6 +395,7 @@ def batched_sssp_banded(
         "want_dag",
         "chord_mode",
         "raw_u16",
+        "transpose",
     ),
 )
 def spf_forward_banded(
@@ -414,6 +415,7 @@ def spf_forward_banded(
     want_dag: bool = True,
     chord_mode: bool = False,
     raw_u16: bool = False,
+    transpose: bool = True,
 ):
     """Banded forward pass: distances (+ optional SP-DAG) + convergence
     verdict.  Output contract matches ops.sssp.spf_forward_ell — dist
@@ -425,8 +427,20 @@ def spf_forward_banded(
     consumers that stay on device (the reduced all-sources bitmap pass)
     then move half the bytes.  The saturation guard still gates
     ``converged``; on a False verdict callers retry via the runner's
-    int32 fallback exactly as before."""
+    int32 fallback exactly as before.
+
+    ``transpose=False`` (want_dag=False only) returns dist in the
+    kernel's native [N, S] layout, skipping the 200MB-scale transposes
+    on BOTH sides of the reduced all-sources product (the bitmap pass
+    consumes [N, P] directly — round-5 measurement)."""
     from .sssp import make_relax_allowed_T, sp_dag_mask_from_T
+
+    # static-arg guard (trace time): the dag path returns [S, N]
+    # unconditionally, so honoring transpose=False there would silently
+    # hand back transposed data whenever S == N
+    assert transpose or not want_dag, (
+        "transpose=False requires want_dag=False"
+    )
 
     metric = edge_metric if use_link_metric else jnp.ones_like(edge_metric)
     extra_T = None
@@ -465,10 +479,10 @@ def spf_forward_banded(
         converged = u16_saturation_verdict(dist, converged)
         dist16 = dist
         if raw_u16 and not want_dag:
-            return dist16.T, None, converged
+            return (dist16.T if transpose else dist16), None, converged
         dist = u16_dist_to_i32(dist)
     if not want_dag:
-        return dist.T, None, converged
+        return (dist.T if transpose else dist), None, converged
     allowed_T = make_relax_allowed_T(
         sources, edge_src, edge_up, node_overloaded, extra_T
     )
@@ -713,10 +727,13 @@ class SpfRunner:
         want_dag: bool = True,
         metric_plane=None,
         raw_u16: bool = False,
+        transpose: bool = True,
     ):
         """One fixed-sweep device call; returns jax (dist, dag, ok).
         With ``raw_u16`` a uint16 banded run returns raw uint16
-        distances (INF16 sentinel) — callers must key on dist.dtype."""
+        distances (INF16 sentinel) — callers must key on dist.dtype.
+        ``transpose=False`` (want_dag=False only) keeps the kernel's
+        native [N, S] layout."""
         from .sssp import spf_forward_ell_sweeps
 
         edge_src, edge_dst, edge_metric, edge_up, node_overloaded = (
@@ -752,6 +769,7 @@ class SpfRunner:
                 want_dag=want_dag,
                 chord_mode=self.chord_mode,
                 raw_u16=raw_u16,
+                transpose=transpose,
             )
         return spf_forward_ell_sweeps(
             sources,
@@ -771,4 +789,5 @@ class SpfRunner:
             want_dag=want_dag,
             small_dist=small,
             raw_u16=raw_u16,
+            transpose=transpose,
         )

@@ -805,6 +805,7 @@ def spf_forward_full_packed(
         "want_dag",
         "small_dist",
         "raw_u16",
+        "transpose",
     ),
 )
 def spf_forward_ell_sweeps(
@@ -821,6 +822,7 @@ def spf_forward_ell_sweeps(
     want_dag: bool = True,
     small_dist: bool = False,
     raw_u16: bool = False,
+    transpose: bool = True,
 ):
     """Fixed-sweep ELL forward: (dist [S, N_cap], dag, converged) — the
     production execution discipline (no data-dependent while_loop, which
@@ -834,6 +836,11 @@ def spf_forward_ell_sweeps(
     as in ops.banded.  ``raw_u16`` additionally returns the raw uint16
     distances (INF16 sentinel) when want_dag=False — consumers key on
     dtype."""
+    # static-arg guard (trace time): the dag path returns [S, N_cap]
+    # unconditionally (see ops.banded.spf_forward_banded)
+    assert transpose or not want_dag, (
+        "transpose=False requires want_dag=False"
+    )
     n_cap = node_overloaded.shape[0]
     extra_T = None
     if extra_edge_mask is not None:
@@ -861,10 +868,14 @@ def spf_forward_ell_sweeps(
         converged = u16_saturation_verdict(dist_old_T, converged)
         dist16_old_T = dist_old_T
         if raw_u16 and not want_dag:
-            return dist_old_T.T, None, converged
+            return (
+                (dist_old_T.T if transpose else dist_old_T),
+                None,
+                converged,
+            )
         dist_old_T = u16_dist_to_i32(dist_old_T)
     if not want_dag:
-        return dist_old_T.T, None, converged
+        return (dist_old_T.T if transpose else dist_old_T), None, converged
     metric = edge_metric if use_link_metric else jnp.ones_like(edge_metric)
     if dist16_old_T is not None:
         dag = sp_dag_mask16_from_T(

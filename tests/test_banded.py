@@ -27,9 +27,9 @@ def to_i32(dist) -> np.ndarray:
     """Normalize a reduced-product distance matrix to int32/INF32: the
     product returns raw uint16 (INF16 sentinel) when the banded kernel
     runs in small-distance mode (ops.allsources contract)."""
-    from openr_tpu.decision.fleet import _col_i32
+    from openr_tpu.decision.fleet import _row_i32
 
-    return _col_i32(np.asarray(dist))
+    return _row_i32(np.asarray(dist))
 
 
 def oracle(topo, sources, extra_mask=None):
@@ -220,12 +220,12 @@ class TestReducedAllSources:
             w.node_overloaded,
         )
         assert bool(ok)
-        dist = to_i32(dist)
+        dist = to_i32(dist)  # [N, P] native layout
         # forward oracle over a sample of routers
         sample = np.asarray([0, 3, 100, 255], np.int32)
         odist, _ = oracle(w, sample)
         for i, v in enumerate(sample):
-            np.testing.assert_array_equal(dist[:, v], odist[i, dests])
+            np.testing.assert_array_equal(dist[v], odist[i, dests])
 
     def test_reverse_respects_drain_semantics(self):
         w = synthetic.wan(256, chords=2, seed=9)
@@ -240,11 +240,11 @@ class TestReducedAllSources:
             w.node_overloaded,
         )
         assert bool(ok)
-        dist = to_i32(dist)
+        dist = to_i32(dist)  # [N, P]
         sample = np.asarray([0, 5, 60, 200], np.int32)
         odist, _ = oracle(w, sample)
         for i, v in enumerate(sample):
-            np.testing.assert_array_equal(dist[:, v], odist[i, dests])
+            np.testing.assert_array_equal(dist[v], odist[i, dests])
 
     def test_non_banded_topology_uses_ell_fallback(self):
         """reduced_all_sources must work when build_banded returns None
@@ -261,11 +261,11 @@ class TestReducedAllSources:
         )
         assert bool(ok)
         assert np.asarray(bitmap).shape[0] == ft.n_nodes
-        dist = np.asarray(dist)
+        dist = np.asarray(dist)  # [N_cap, P]
         sample = np.asarray([0, 9, 30], np.int32)
         odist, _ = oracle(ft, sample)
         for i, v in enumerate(sample):
-            np.testing.assert_array_equal(dist[:, v], odist[i, dests])
+            np.testing.assert_array_equal(dist[v], odist[i, dests])
 
     def test_bitmap_excludes_drained_neighbor(self):
         """Ring with an overloaded node: the coincidental distance
@@ -317,7 +317,7 @@ class TestReducedAllSources:
             w.node_overloaded,
         )
         assert bool(ok)
-        dist = to_i32(dist)  # [P, N]
+        dist = to_i32(dist)  # [N, P] native layout
         bitmap = np.asarray(bitmap)  # [N, P, W]
         e = w.n_edges
         src = w.edge_src[:e]
@@ -328,7 +328,7 @@ class TestReducedAllSources:
 
         out_slot, _ = _build_out_slots(w.edge_src, w.edge_dst, e)
         for p_i in range(len(dests)):
-            d = dist[p_i]  # dist(x -> dest p)
+            d = dist[:, p_i]  # dist(x -> dest p)
             on = (d[src] < INF32 * 0 + (1 << 30)) & (
                 met + d[dst] == d[src]
             )

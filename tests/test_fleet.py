@@ -40,7 +40,7 @@ def test_inf_sentinel_matches_kernels():
     from openr_tpu.ops.sssp import INF32 as KERNEL_INF
 
     assert INF32 == int(KERNEL_INF)
-    # the uint16 sentinel _col_i32 keys on must track the kernel's: a
+    # the uint16 sentinel _row_i32 keys on must track the kernel's: a
     # retuned ops.banded.INF16 with a stale mirror here would classify
     # unreachable (sentinel) entries as finite distances
     assert INF16 == int(KERNEL_INF16)
