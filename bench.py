@@ -392,7 +392,9 @@ def bench_allsrc_full_wan100k(topo, n_prefixes: int = 1024) -> dict:
     # pre-stage the rolled distance inputs OUTSIDE the timed window: an
     # in-window jnp.roll would add a second dispatch + a full-matrix
     # copy to every sample and masquerade as bitmap cost
-    staged_dists = [jnp.roll(dist_k, i, axis=0) for i in range(1, 6)]
+    # [N, P] layout: roll the DESTINATION axis so each staged matrix
+    # mirrors a rolled-dest question (distinct-input replay guard)
+    staged_dists = [jnp.roll(dist_k, i, axis=1) for i in range(1, 6)]
     import jax as _jax
 
     _jax.block_until_ready(staged_dists)
@@ -430,6 +432,130 @@ def bench_allsrc_full_wan100k(topo, n_prefixes: int = 1024) -> dict:
             "98-tile [N,N] sweep (197.7 s); the [N,N] product remains "
             "un-materializable (40 GB) and unconsumed by route building. "
             "Outputs stay on device for the per-router route builds."
+        ),
+    }
+
+
+def bench_fleet_warm_wan100k(topo, n_prefixes: int = 1024) -> dict:
+    """Warm-started fleet rebuild (round-5): after an improvement-only
+    change (here: flap recovery — a downed ring link comes back up) the
+    previous product is an elementwise upper bound, so the relax seeds
+    from it and converges in a few sweeps instead of the cold count
+    (ops.banded.spf_forward_banded; gate in decision.fleet).  Reports
+    cold vs warm end-to-end for the SAME final topology; warm == cold
+    distances are asserted before timing.  The reference has no
+    equivalent: its SPF memo is invalidated wholesale on any topology
+    change (openr/decision/LinkState.cpp:714-719)."""
+    import jax
+    import jax.numpy as jnp
+
+    from benchmarks.synthetic import reversed_topology
+    from openr_tpu.ops import allsources as asrc
+    from openr_tpu.ops.banded import SpfRunner
+
+    n = topo.n_nodes
+    rev = reversed_topology(topo)
+    rng = np.random.default_rng(7)
+    dests = np.sort(
+        rng.choice(n, size=n_prefixes, replace=False).astype(np.int32)
+    )
+    out = asrc.build_out_ell(topo.edge_src, topo.edge_dst, topo.n_edges, n)
+    fwd_metric = jnp.asarray(topo.edge_metric)
+    fwd_up = jnp.asarray(topo.edge_up)
+    fwd_ov = jnp.asarray(topo.node_overloaded)
+
+    # "before" topology: one ring link down (both directions)
+    down_up = rev.edge_up.copy()
+    down_eids = np.flatnonzero(
+        ((rev.edge_src[: rev.n_edges] == 0) & (rev.edge_dst[: rev.n_edges] == 1))
+        | ((rev.edge_src[: rev.n_edges] == 1) & (rev.edge_dst[: rev.n_edges] == 0))
+    )
+    down_up[down_eids] = False
+    runner_down = SpfRunner(
+        rev.ell, rev.banded, rev.edge_src, rev.edge_dst, rev.edge_metric,
+        down_up, rev.node_overloaded, rev.n_edges,
+    )
+    runner_down.stage()
+    dist_before, _, ok = asrc.reduced_all_sources(
+        dests, runner_down, out, fwd_metric, fwd_up, fwd_ov
+    )
+    assert bool(ok)
+
+    # "after" topology: the link restored (the pristine reverse runner)
+    runner = rev.runner
+    dist_cold, _, ok = asrc.reduced_all_sources(
+        dests, runner, out, fwd_metric, fwd_up, fwd_ov
+    )
+    assert bool(ok)
+    cold_sweeps = runner.hint
+
+    # minimal converged warm sweep count (fixed-sweep probes)
+    warm_sweeps = None
+    for s in (1, 2, 3, 4, 6, 8, 12, cold_sweeps):
+        dist_w, _, okw = asrc.reduced_all_sources(
+            dests, runner, out, fwd_metric, fwd_up, fwd_ov,
+            n_sweeps=s, init_dist=dist_before,
+        )
+        if bool(okw):
+            warm_sweeps = s
+            break
+    assert warm_sweeps is not None
+    # exactness: warm fixed point == cold fixed point
+    assert bool(jnp.all(dist_w == dist_cold))
+
+    # timing: distinct pre-staged (dests, init) pairs per rep (transport
+    # replay guard); init columns roll WITH the dest roll so each warm
+    # rep is the same question under a permuted dest order
+    # reps+warmup+1 distinct pairs per timing fn: a wrapped cycle would
+    # re-dispatch byte-identical inputs inside the timed window (replay
+    # guard degeneracy)
+    staged = [
+        (np.roll(dests, i), jnp.roll(dist_before, i, axis=1))
+        for i in range(1, 9)
+    ]
+    jax.block_until_ready([s[1] for s in staged])
+    rep = [0]
+
+    def run_warm():
+        d, init = staged[rep[0] % len(staged)]
+        rep[0] += 1
+        dist, bm, ok = asrc.reduced_all_sources(
+            d, runner, out, fwd_metric, fwd_up, fwd_ov,
+            n_sweeps=warm_sweeps, init_dist=init,
+        )
+        jax.block_until_ready((dist, bm))
+        return ok
+
+    def run_cold():
+        d, _ = staged[rep[0] % len(staged)]
+        rep[0] += 1
+        dist, bm, ok = asrc.reduced_all_sources(
+            d, runner, out, fwd_metric, fwd_up, fwd_ov,
+            n_sweeps=cold_sweeps,
+        )
+        jax.block_until_ready((dist, bm))
+        return ok
+
+    warm_times = _time_device(run_warm, reps=5, warmup=1)
+    assert bool(run_warm())
+    cold_times = _time_device(run_cold, reps=5, warmup=1)
+    assert bool(run_cold())
+    return {
+        "topology": topo.name,
+        "n_nodes": n,
+        "n_prefix_destinations": n_prefixes,
+        "scenario": "ring link 0-1 flap recovery",
+        "warm_sweeps": warm_sweeps,
+        "cold_sweeps": cold_sweeps,
+        "warm_ms_min": round(min(warm_times), 1),
+        "warm_ms_all": [round(t, 1) for t in warm_times],
+        "cold_ms_min": round(min(cold_times), 1),
+        "cold_ms_all": [round(t, 1) for t in cold_times],
+        "note": (
+            "round-5 warm start: the previous fleet product seeds the "
+            "relax after improvement-only changes (upper-bound init, "
+            "exactness certified by the fixed-point verdict; "
+            "warm == cold asserted above before timing)"
         ),
     }
 
@@ -1282,6 +1408,8 @@ DEVICE_ROWS = {
     "allsrc_reduced_p128_wan100k": lambda t: bench_allsrc_full_wan100k(
         t.wan, n_prefixes=128
     ),
+    # round-5 warm start: flap-recovery rebuild from the previous product
+    "fleet_warm_rebuild_wan100k": lambda t: bench_fleet_warm_wan100k(t.wan),
     # BASELINE config #3: dual-metric KSP at 100k (r3 next #6)
     "ksp_dual_metric_wan100k": lambda t: bench_ksp_dual_metric_wan100k(
         t.wan

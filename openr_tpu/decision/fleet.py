@@ -56,6 +56,43 @@ def _row_i32(row: np.ndarray) -> np.ndarray:
 log = logging.getLogger(__name__)
 
 
+def _usable_edge_table(csr):
+    """Canonical (directed-pair key, min metric) table of USABLE edges —
+    the improvement-only gate's comparison unit.  Distances depend only
+    on the min metric per usable directed (src, dst) pair (parallel
+    links matter for next-hop slots, not distances)."""
+    e = csr.n_edges
+    up = np.asarray(csr.edge_up[:e], dtype=bool)
+    src = np.asarray(csr.edge_src[:e], dtype=np.int64)[up]
+    dst = np.asarray(csr.edge_dst[:e], dtype=np.int64)[up]
+    met = np.asarray(csr.edge_metric[:e], dtype=np.int64)[up]
+    key = (src << 32) | dst
+    order = np.argsort(key, kind="stable")
+    key, met = key[order], met[order]
+    first = np.r_[True, key[1:] != key[:-1]]
+    uniq = key[first]
+    min_met = np.minimum.reduceat(met, np.flatnonzero(first))
+    return uniq, min_met
+
+
+def _improvement_only(
+    old_keys, old_met, old_ov, new_keys, new_met, new_ov
+) -> bool:
+    """True iff the new graph can only have SHORTER-OR-EQUAL distances
+    than the old one: every old usable directed pair is still usable
+    with metric <= old, and no node gained the overload bit.  This is
+    the warm-start proof obligation of ops.banded.spf_forward_banded —
+    under it the previous product is an elementwise upper bound."""
+    if np.any(new_ov & ~old_ov):
+        return False
+    pos = np.searchsorted(new_keys, old_keys)
+    if np.any(pos >= len(new_keys)) or np.any(
+        new_keys[np.minimum(pos, max(len(new_keys) - 1, 0))] != old_keys
+    ):
+        return False
+    return bool(np.all(new_met[pos] <= old_met))
+
+
 def _reverse_runner(csr, hint: Optional[int] = None):
     """SpfRunner over the REVERSED directed edges of a CsrTopology
     snapshot (same construction as benchmarks.synthetic.reversed_topology,
@@ -119,23 +156,37 @@ class FleetRouteView:
         self._node_id = dict(csr.node_id)
         # runtime-state snapshot for the host-side per-link checks
         self._overloaded = csr.node_overloaded.copy()
+        # canonical usable-edge table for the warm-start improvement gate
+        # (the next view compares against it; ~10ms host work at 800k)
+        self._edge_keys, self._edge_met = _usable_edge_table(csr)
         self._dist_dev = None  # jax [N*, P] — row per router (native
         #   kernel layout; a router's fetch is one contiguous row)
         self._bitmap_dev = None  # jax [N, P, W]
         self._out = None  # ops.allsources.OutEll
         self._rows: dict[int, np.ndarray] = {}  # node id -> [P] int32
         self.converged = False
+        self.warm = False  # computed from a previous view's distances
         self.sweep_hint: Optional[int] = None
 
     # -- device round --------------------------------------------------------
 
-    def compute(self, hint_seed: Optional[int] = None) -> None:
+    def compute(
+        self,
+        hint_seed: Optional[int] = None,
+        init_from: Optional["FleetRouteView"] = None,
+    ) -> None:
         """One device ROUND — the P-source reverse relax plus the ECMP
         bitmap pass (two pipelined dispatches; reduced_all_sources
         defaults to unfused on the round-5 measurement that the
         single-program fusion schedules worse).  `hint_seed` carries the
         previous view's learned sweep count across topology versions
-        (same-shape seeding)."""
+        (same-shape seeding).
+
+        `init_from` warm-starts the relax from a previous view's device
+        distances.  The CALLER (FleetViewCache.view) must have proven
+        the improvement-only gate (_improvement_only) plus node/dest
+        universe equality — an un-gated init can silently fix-point
+        below the true distances (ops.banded.spf_forward_banded)."""
         from ..ops import allsources as asrc
 
         dest_ids = np.asarray(
@@ -149,6 +200,13 @@ class FleetRouteView:
             self.csr.n_nodes,
             out_slot=self.csr.out_slot,
         )
+        init = init_from._dist_dev if init_from is not None else None
+        if init is not None and runner.bg is None:
+            # the ELL fallback ignores dist0 (cold run): claiming warm
+            # would mislabel the view AND poison _warm_hints with a cold
+            # sweep count while the warm default seed pays doubling
+            # retries of full-P dispatches
+            init = None
         dist, bitmap, ok = asrc.reduced_all_sources(
             dest_ids,
             runner,
@@ -156,11 +214,13 @@ class FleetRouteView:
             self.csr.edge_metric,
             self.csr.edge_up,
             self.csr.node_overloaded,
+            init_dist=init,
         )
         assert bool(ok), "fleet reverse SSSP did not reach its fixed point"
         self._dist_dev = dist
         self._bitmap_dev = bitmap
         self.converged = True
+        self.warm = init is not None
         self.sweep_hint = runner.hint
 
     # -- host queries --------------------------------------------------------
@@ -261,6 +321,10 @@ class FleetViewCache:
         # a rebuilt view of a same-shaped topology starts from the learned
         # count instead of re-learning it by doubling
         self._hints: dict[tuple[int, int], int] = {}
+        # warm (previous-product-seeded) rebuilds converge in far fewer
+        # sweeps than cold ones; learning them into _hints would poison
+        # every later cold rebuild, so they get their own store
+        self._warm_hints: dict[tuple[int, int], int] = {}
 
     def is_warm(self, ls: LinkState, dest_names: list[str]) -> bool:
         """True when a cached view already answers this (version, dests) —
@@ -275,7 +339,16 @@ class FleetViewCache:
     def view(
         self, ls: LinkState, dest_names: list[str], csr=None
     ) -> Optional[FleetRouteView]:
-        """Computed view for this (version, dests); None when empty."""
+        """Computed view for this (version, dests); None when empty.
+
+        A rebuild WARM-STARTS from the previous view's device distances
+        when the change since was improvement-only (link up, metric
+        decrease, overload clear) over the same node/dest universe —
+        the upper-bound condition ops.banded.spf_forward_banded
+        requires.  The flap-recovery half of reconvergence then pays a
+        few relax sweeps instead of the full cold count; worsening
+        changes (link down, metric increase, drain) cold-start exactly
+        as before."""
         if not dest_names:
             return None
         if self.is_warm(ls, dest_names):
@@ -286,13 +359,42 @@ class FleetViewCache:
             csr = CsrTopology.from_link_state(ls)
         elif csr.version != ls.version:
             csr.refresh(ls)
+        prev = self._views.get(ls)
         view = FleetRouteView(csr, dest_names)
         key = (csr.n_nodes, csr.n_edges)
-        view.compute(hint_seed=self._hints.get(key))
-        if view.sweep_hint is not None:
-            # max-merge, like DeviceSpfBackend._harvest_hint
-            self._hints[key] = max(
-                self._hints.get(key, 0), view.sweep_hint
+        init_from = None
+        if (
+            prev is not None
+            and prev.converged
+            and prev._dist_dev is not None
+            and prev.dest_names == view.dest_names
+            and prev._node_id == view._node_id
+            and prev._overloaded.shape == view._overloaded.shape
+            and _improvement_only(
+                prev._edge_keys,
+                prev._edge_met,
+                prev._overloaded,
+                view._edge_keys,
+                view._edge_met,
+                view._overloaded,
             )
+        ):
+            init_from = prev
+        if init_from is not None:
+            view.compute(
+                hint_seed=self._warm_hints.get(key, 4),
+                init_from=init_from,
+            )
+            if view.sweep_hint is not None:
+                self._warm_hints[key] = max(
+                    self._warm_hints.get(key, 0), view.sweep_hint
+                )
+        else:
+            view.compute(hint_seed=self._hints.get(key))
+            if view.sweep_hint is not None:
+                # max-merge, like DeviceSpfBackend._harvest_hint
+                self._hints[key] = max(
+                    self._hints.get(key, 0), view.sweep_hint
+                )
         self._views[ls] = view
         return view

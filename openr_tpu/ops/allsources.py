@@ -195,6 +195,7 @@ def reduced_all_sources(
     node_overloaded,
     n_sweeps: Optional[int] = None,
     fused: bool = False,
+    init_dist=None,
 ):
     """Fleet-wide route-building input in one device round:
     (dist [N*, P] jax — dist[v, p] = dist(v -> p), nh_bitmap
@@ -221,8 +222,23 @@ def reduced_all_sources(
     combined program worse) while the second dispatch of the unfused
     path overlaps the relax and costs ~30 ms marginal — so fusion only
     pays when the transport's flat per-dispatch fee is in its degraded
-    (~100-400 ms) window."""
+    (~100-400 ms) window.
+
+    `init_dist` ([N*, P], either distance dtype) warm-starts the relax
+    from a caller-PROVEN elementwise upper bound — the previous product
+    of the same (node universe, dest set) after improvement-only
+    topology changes (see ops.banded.spf_forward_banded for the safety
+    argument and decision.fleet for the gate).  A converged warm round
+    equals the cold one exactly; callers pair it with a small adaptive
+    hint since few sweeps usually suffice.  Banded path only (the ELL
+    fallback cold-starts; the fused program ignores it too)."""
     import numpy as _np
+
+    if fused and init_dist is not None:
+        # the fused program has no dist0 input: attempts would run cold
+        # while probes run warm, and refine-down would record a hint no
+        # cold fused round can meet
+        raise ValueError("fused=True does not support init_dist")
 
     dest_ids = jnp.asarray(_np.asarray(dest_ids, dtype=_np.int32))
 
@@ -243,7 +259,12 @@ def reduced_all_sources(
         # raw uint16 distances when the banded kernel runs small: the
         # bitmap pass gathers half the bytes (ecmp_bitmap keys on dtype)
         dist, _, ok = reverse_runner.run_once(
-            dest_ids, sweeps, want_dag=False, raw_u16=True, transpose=False
+            dest_ids,
+            sweeps,
+            want_dag=False,
+            raw_u16=True,
+            transpose=False,
+            dist0=init_dist,
         )
         return dist, None, ok
 

@@ -416,11 +416,25 @@ def spf_forward_banded(
     chord_mode: bool = False,
     raw_u16: bool = False,
     transpose: bool = True,
+    dist0: Optional[jax.Array] = None,  # [N, S] warm-start upper bound
 ):
     """Banded forward pass: distances (+ optional SP-DAG) + convergence
     verdict.  Output contract matches ops.sssp.spf_forward_ell — dist
     [S, N] int32 (INF32 unreachable), dag [S, E_cap] — so callers can
     swap kernels by topology shape.
+
+    ``dist0`` warm-starts the relax from a caller-supplied ELEMENTWISE
+    UPPER BOUND on the true distances ([N, S], either dtype — converted
+    to the run's domain here).  Source rows are re-pinned to 0, so any
+    upper bound is safe: relax candidates never drop below the true
+    distance (d[u] >= true[u] gives d[u]+w >= true[v]), the iteration is
+    monotone non-increasing, and the final verification sweep certifies
+    the exact fixed point — a converged warm run equals the cold result
+    bit-for-bit.  Callers OWN the upper-bound proof: previous-view
+    distances qualify only when every change since is an improvement
+    (link up, metric decrease, overload clear — decision.fleet gates
+    this); after a worsening change they may undershoot and MUST NOT be
+    passed (the fixed-point check cannot detect a too-low init).
 
     ``raw_u16`` (uint16 runs, want_dag=False only) returns dist [S, N]
     in the raw uint16 domain (INF16 unreachable) instead of int32 —
@@ -459,8 +473,21 @@ def spf_forward_banded(
             if extra_T.shape[1] > 1
             else jnp.broadcast_to(extra_T, (extra_T.shape[0], sources.shape[0]))
         )
+    d0 = make_dist0_orig(sources, bg.n_nodes, small_dist=small_dist)
+    if dist0 is not None:
+        init = dist0[: bg.n_nodes]
+        if small_dist and init.dtype != jnp.uint16:
+            # clamp into the uint16 domain (INF32 and anything saturated
+            # map to the INF16 sentinel — still an upper bound)
+            init = jnp.minimum(init, INF16).astype(jnp.uint16)
+        elif not small_dist and init.dtype != jnp.int32:
+            init = jnp.where(
+                init >= INF16, jnp.int32(INF32), init.astype(jnp.int32)
+            )
+        # re-pin sources to 0; elsewhere keep the caller's bound
+        d0 = jnp.minimum(d0, init)
     dist, converged = batched_sssp_banded(
-        make_dist0_orig(sources, bg.n_nodes, small_dist=small_dist),
+        d0,
         bg,
         edge_up,
         metric,
@@ -728,12 +755,15 @@ class SpfRunner:
         metric_plane=None,
         raw_u16: bool = False,
         transpose: bool = True,
+        dist0=None,
     ):
         """One fixed-sweep device call; returns jax (dist, dag, ok).
         With ``raw_u16`` a uint16 banded run returns raw uint16
         distances (INF16 sentinel) — callers must key on dist.dtype.
         ``transpose=False`` (want_dag=False only) keeps the kernel's
-        native [N, S] layout."""
+        native [N, S] layout.  ``dist0`` warm-starts the banded kernel
+        from a caller-proven upper bound (see spf_forward_banded; the
+        ELL fallback ignores it — cold start, still exact)."""
         from .sssp import spf_forward_ell_sweeps
 
         edge_src, edge_dst, edge_metric, edge_up, node_overloaded = (
@@ -770,6 +800,7 @@ class SpfRunner:
                 chord_mode=self.chord_mode,
                 raw_u16=raw_u16,
                 transpose=transpose,
+                dist0=dist0,
             )
         return spf_forward_ell_sweeps(
             sources,

@@ -429,3 +429,192 @@ class TestAnyNodeQuery:
         ls = square()
         ps = prefix_state_with(("4", "0", PrefixEntry(prefix=PFX)))
         assert SpfSolver("1").any_node_route_db({"0": ls}, ps, "zz") is None
+
+
+class TestWarmStart:
+    """Warm-started fleet rebuilds: after improvement-only changes the
+    previous view's distances seed the relax (upper-bound init,
+    ops.banded.spf_forward_banded); the result must equal a cold build
+    bit-for-bit, and any worsening change must fall back to cold.
+
+    Fixtures are 64-node rings: the warm path engages only where the
+    BANDED kernel runs (build_banded needs >=64 nodes with circulant
+    structure; the ELL fallback ignores dist0 and stays cold)."""
+
+    @staticmethod
+    def ring_ls(n=64, metric=lambda a, b: 20):
+        def name(i):
+            return f"r{i % 64:03d}" if n <= 1000 else f"r{i % n:06d}"
+
+        adj_map = {}
+        labels = {}
+        for i in range(n):
+            me = name(i)
+            adj_map[me] = [
+                adj(me, name(i + d), metric=metric(i, (i + d) % n))
+                for d in (1, -1, 2, -2)
+            ]
+            labels[me] = 1000 + i
+        return build_link_state(adj_map, labels=labels)
+
+    @staticmethod
+    def ring_adjs(i, metric=lambda a, b: 20, drop=None):
+        def name(j):
+            return f"r{j % 64:03d}"
+
+        return [
+            adj(name(i), name(i + d), metric=metric(i, (i + d) % 64))
+            for d in (1, -1, 2, -2)
+            if d != drop
+        ]
+
+    def _dists(self, view):
+        import numpy as np
+
+        return np.asarray(view._dist_dev)
+
+    def _assert_banded(self, view):
+        # the fixture must actually run the banded kernel or this class
+        # tests nothing (the ELL fallback never warms)
+        from openr_tpu.ops.banded import build_banded
+
+        assert (
+            build_banded(
+                view.csr.edge_src,
+                view.csr.edge_dst,
+                view.csr.n_edges,
+                view.csr.n_nodes,
+            )
+            is not None
+        )
+
+    def _rebuild_pair(self, mutate):
+        """(warm-capable view, fresh cold view) after `mutate(ls)` on
+        two identically-constructed LinkStates."""
+        import numpy as np
+
+        views = []
+        for use_cache in (True, False):
+            ls = self.ring_ls()
+            ps = prefix_state_with(
+                ("r063", "0", PrefixEntry(prefix=PFX)),
+                ("r000", "0", PrefixEntry(prefix="::2:0/112")),
+            )
+            dests = fleet_destinations(ls, ps)
+            cache = FleetViewCache()
+            if use_cache:
+                v1 = cache.view(ls, dests)
+                assert not v1.warm
+                self._assert_banded(v1)
+            mutate(ls)
+            views.append(cache.view(ls, fleet_destinations(ls, ps)))
+        warm_view, cold_view = views
+        assert not cold_view.warm
+        np.testing.assert_array_equal(
+            self._dists(warm_view), self._dists(cold_view)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(warm_view._bitmap_dev),
+            np.asarray(cold_view._bitmap_dev),
+        )
+        return warm_view, cold_view
+
+    def _set_node(self, ls, i, **kw):
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name=f"r{i:03d}",
+                adjacencies=self.ring_adjs(i, **{
+                    k: v for k, v in kw.items() if k in ("metric", "drop")
+                }),
+                is_overloaded=kw.get("is_overloaded", False),
+                node_label=1000 + i,
+                area="0",
+            )
+        )
+
+    def test_metric_decrease_warm_starts(self):
+        warm, _ = self._rebuild_pair(
+            lambda ls: self._set_node(
+                ls, 0, metric=lambda a, b: 5 if b == 1 else 20
+            )
+        )
+        assert warm.warm
+
+    def test_metric_increase_cold_starts(self):
+        warm, _ = self._rebuild_pair(
+            lambda ls: self._set_node(
+                ls, 0, metric=lambda a, b: 90 if b == 1 else 20
+            )
+        )
+        assert not warm.warm
+
+    def test_link_down_cold_then_up_warm(self):
+        import numpy as np
+
+        ls = self.ring_ls()
+        ps = prefix_state_with(("r063", "0", PrefixEntry(prefix=PFX)))
+        dests = fleet_destinations(ls, ps)
+        cache = FleetViewCache()
+        v1 = cache.view(ls, dests)
+        # link r000-r001 down: a WORSENING change -> cold rebuild
+        self._set_node(ls, 0, drop=1)
+        v2 = cache.view(ls, dests)
+        assert not v2.warm
+        # link back up: flap recovery -> warm rebuild
+        self._set_node(ls, 0)
+        v3 = cache.view(ls, dests)
+        assert v3.warm
+        # warm result equals v1 (same topology as the original)
+        np.testing.assert_array_equal(self._dists(v3), self._dists(v1))
+        # and the daemon-level answer stays correct
+        assert_fleet_parity(
+            {"0": ls}, ps, nodes=[f"r{i:03d}" for i in (0, 1, 2, 31, 63)]
+        )
+
+    def test_overload_set_cold_clear_warm(self):
+        ls = self.ring_ls()
+        ps = prefix_state_with(("r063", "0", PrefixEntry(prefix=PFX)))
+        dests = fleet_destinations(ls, ps)
+        cache = FleetViewCache()
+        cache.view(ls, dests)
+        self._set_node(ls, 5, is_overloaded=True)
+        v2 = cache.view(ls, dests)
+        assert not v2.warm  # draining a node is a worsening change
+        self._set_node(ls, 5)
+        v3 = cache.view(ls, dests)
+        assert v3.warm  # un-draining only improves distances
+
+    def test_ell_fallback_never_warms(self):
+        # small (non-banded) topology + improvement-only change: the
+        # gate passes but the ELL kernel ignores dist0, so the view must
+        # NOT claim warm (it would poison _warm_hints with cold counts)
+        ls = square()
+        ps = prefix_state_with(("4", "0", PrefixEntry(prefix=PFX)))
+        dests = fleet_destinations(ls, ps)
+        cache = FleetViewCache()
+        cache.view(ls, dests)
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="1",
+                adjacencies=[adj("1", "2", metric=5), adj("1", "3")],
+                node_label=101,
+                area="0",
+            )
+        )
+        v2 = cache.view(ls, dests)
+        assert not v2.warm
+
+    def test_dest_change_blocks_warm(self):
+        ls = self.ring_ls()
+        # label-free dest control is impossible here (every ring node is
+        # labeled), so change the ADVERTISER set size via a node whose
+        # label is already a dest: drop a prefix advertised by a node
+        # OUTSIDE the label set — instead, flip dest equality by asking
+        # with an explicitly different dest list
+        ps = prefix_state_with(("r063", "0", PrefixEntry(prefix=PFX)))
+        cache = FleetViewCache()
+        dests = fleet_destinations(ls, ps)
+        cache.view(ls, dests)
+        self._set_node(ls, 0, metric=lambda a, b: 5 if b == 1 else 20)
+        v2 = cache.view(ls, dests[:-1])  # same topology, fewer dests
+        assert not v2.warm
