@@ -174,19 +174,27 @@ class FleetRouteView:
         self,
         hint_seed: Optional[int] = None,
         init_from: Optional["FleetRouteView"] = None,
+        warm_seed: Optional[int] = None,
     ) -> None:
         """One device ROUND — the P-source reverse relax plus the ECMP
         bitmap pass (two pipelined dispatches; reduced_all_sources
         defaults to unfused on the round-5 measurement that the
         single-program fusion schedules worse).  `hint_seed` carries the
-        previous view's learned sweep count across topology versions
-        (same-shape seeding).
+        previous view's learned COLD sweep count across topology
+        versions (same-shape seeding).
 
         `init_from` warm-starts the relax from a previous view's device
         distances.  The CALLER (FleetViewCache.view) must have proven
         the improvement-only gate (_improvement_only) plus node/dest
         universe equality — an un-gated init can silently fix-point
-        below the true distances (ops.banded.spf_forward_banded)."""
+        below the true distances (ops.banded.spf_forward_banded).
+        `warm_seed` is the sweep seed used ONLY when the warm path
+        actually engages; whether it does depends on the runner's
+        bandedness, which is known only after the runner is built here
+        (the ELL fallback ignores dist0 and must keep the cold seed, or
+        adapt() would pay doubling retries of full-P dispatches from an
+        undersized warm default).  Callers read `self.warm` afterwards
+        to route hint harvesting."""
         from ..ops import allsources as asrc
 
         dest_ids = np.asarray(
@@ -204,9 +212,10 @@ class FleetRouteView:
         if init is not None and runner.bg is None:
             # the ELL fallback ignores dist0 (cold run): claiming warm
             # would mislabel the view AND poison _warm_hints with a cold
-            # sweep count while the warm default seed pays doubling
-            # retries of full-P dispatches
+            # sweep count
             init = None
+        elif init is not None and warm_seed is not None:
+            runner.hint = warm_seed
         dist, bitmap, ok = asrc.reduced_all_sources(
             dest_ids,
             runner,
@@ -380,21 +389,17 @@ class FleetViewCache:
             )
         ):
             init_from = prev
-        if init_from is not None:
-            view.compute(
-                hint_seed=self._warm_hints.get(key, 4),
-                init_from=init_from,
-            )
-            if view.sweep_hint is not None:
-                self._warm_hints[key] = max(
-                    self._warm_hints.get(key, 0), view.sweep_hint
-                )
-        else:
-            view.compute(hint_seed=self._hints.get(key))
-            if view.sweep_hint is not None:
-                # max-merge, like DeviceSpfBackend._harvest_hint
-                self._hints[key] = max(
-                    self._hints.get(key, 0), view.sweep_hint
-                )
+        # cold seed always flows in; the warm seed applies only if the
+        # warm path engages (compute() decides — ELL fallbacks stay
+        # cold), and harvesting routes by what actually ran
+        view.compute(
+            hint_seed=self._hints.get(key),
+            init_from=init_from,
+            warm_seed=self._warm_hints.get(key, 4),
+        )
+        if view.sweep_hint is not None:
+            store = self._warm_hints if view.warm else self._hints
+            # max-merge, like DeviceSpfBackend._harvest_hint
+            store[key] = max(store.get(key, 0), view.sweep_hint)
         self._views[ls] = view
         return view

@@ -32,6 +32,7 @@ import logging
 import struct as _s
 from typing import Optional
 
+from .. import types as T
 from ..runtime.eventbase import OpenrEventBase
 from . import thrift_binary as tb
 
@@ -94,6 +95,36 @@ _AREA_ARGS = tb.StructSpec(
     ),
 )
 _PEERS_MAP = ("map", tb.T_STRING, ("struct", tb.PEER_SPEC))
+# OpenrCtrl.thrift:313 getRouteDbComputed(1: string nodeName)
+_NODE_ARGS = tb.StructSpec(
+    "node_args",
+    None,
+    (
+        tb.Field(
+            1, "node_name", tb.T_STRING, dec=lambda b: b.decode(), default=""
+        ),
+    ),
+)
+# OpenrCtrl.thrift:322 getUnicastRoutesFiltered(1: list<string> prefixes)
+_PREFIXES_ARGS = tb.StructSpec(
+    "prefixes_args",
+    None,
+    (
+        tb.Field(
+            1,
+            "prefixes",
+            ("list", tb.T_STRING),
+            dec=lambda xs: [x.decode() for x in xs],
+            default=[],
+        ),
+    ),
+)
+# OpenrCtrl.thrift:335 getMplsRoutesFiltered(1: list<i32> labels)
+_LABELS_ARGS = tb.StructSpec(
+    "labels_args",
+    None,
+    (tb.Field(1, "labels", ("list", tb.T_I32), default=[]),),
+)
 
 
 class ThriftBinaryShim(OpenrEventBase):
@@ -105,13 +136,22 @@ class ThriftBinaryShim(OpenrEventBase):
         host: str = "::1",
         port: int = 0,
         node_name: str = "",
+        decision=None,
+        fib=None,
     ) -> None:
         super().__init__(name="thrift-shim")
         self.kvstore = kvstore
         self.host = host
         self.port = port
         self.node_name = node_name
+        self.decision = decision
+        self.fib = fib
         self._server: Optional[asyncio.AbstractServer] = None
+
+    def _fib(self):
+        if self.fib is None:
+            raise RuntimeError("fib module not attached")
+        return self.fib
 
     def run(self) -> None:
         super().run()
@@ -264,6 +304,69 @@ class ThriftBinaryShim(OpenrEventBase):
                     for nm, ps in peers.items()
                 }
                 return self._reply(name, seqid, _PEERS_MAP, wire)
+            if name == "getRouteDb":
+                # reference: routes as tracked by the FIB module
+                # (OpenrCtrl.thrift:298)
+                tb.read_struct(r, _EMPTY_ARGS)
+                unicast, mpls = self._fib().get_route_db()
+                db = T.RouteDatabase(
+                    this_node_name=self.node_name,
+                    unicast_routes=unicast,
+                    mpls_routes=mpls,
+                )
+                return self._reply(
+                    name, seqid, ("struct", tb.ROUTE_DATABASE), db
+                )
+            if name == "getRouteDbComputed":
+                # Decision-computed, any node's perspective
+                # (OpenrCtrl.thrift:313, Decision.cpp:1510-1530); empty
+                # nodeName = this node — served from the fleet product
+                # when a warm view covers the target
+                args = tb.read_struct(r, _NODE_ARGS)
+                if self.decision is None:
+                    raise RuntimeError("decision module not attached")
+                rib = self.decision.get_route_db(args["node_name"])
+                db = T.RouteDatabase(
+                    this_node_name=args["node_name"] or self.node_name,
+                    unicast_routes=[
+                        e.to_unicast_route()
+                        for e in rib.unicast_routes.values()
+                    ],
+                    mpls_routes=[
+                        e.to_mpls_route() for e in rib.mpls_routes.values()
+                    ],
+                )
+                return self._reply(
+                    name, seqid, ("struct", tb.ROUTE_DATABASE), db
+                )
+            if name in ("getUnicastRoutes", "getUnicastRoutesFiltered"):
+                args = (
+                    tb.read_struct(r, _PREFIXES_ARGS)
+                    if name.endswith("Filtered")
+                    else (tb.read_struct(r, _EMPTY_ARGS) or {"prefixes": []})
+                )
+                routes = self._fib().get_unicast_routes(
+                    args.get("prefixes") or None
+                )
+                return self._reply(
+                    name,
+                    seqid,
+                    ("list", ("struct", tb.UNICAST_ROUTE)),
+                    routes,
+                )
+            if name in ("getMplsRoutes", "getMplsRoutesFiltered"):
+                args = (
+                    tb.read_struct(r, _LABELS_ARGS)
+                    if name.endswith("Filtered")
+                    else (tb.read_struct(r, _EMPTY_ARGS) or {"labels": []})
+                )
+                mpls = self._fib().get_route_db()[1]
+                labels = set(args.get("labels") or [])
+                if labels:
+                    mpls = [m for m in mpls if m.top_label in labels]
+                return self._reply(
+                    name, seqid, ("list", ("struct", tb.MPLS_ROUTE)), mpls
+                )
             if name == "setKvStoreKeyVals":
                 args = tb.read_struct(r, _SET_ARGS)
                 params = args["set_params"]

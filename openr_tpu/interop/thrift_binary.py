@@ -592,6 +592,216 @@ ADJACENCY_DATABASE = StructSpec(
 
 
 # ---------------------------------------------------------------------------
+# route structs (Network.thrift / Types.thrift RouteDatabase) — the
+# Decision/Fib query surface (round-5 shim extension)
+# ---------------------------------------------------------------------------
+
+
+def _pack_addr(s: str) -> bytes:
+    """BinaryAddress.addr is plain `binary` on the wire
+    (Network.thrift:57).  Real IPs pack to 4/16 bytes; non-IP transport
+    addresses (test fabrics, in-process meshes) ride as raw UTF-8."""
+    if not s:
+        return b""
+    try:
+        return ipaddress.ip_address(s).packed
+    except ValueError:
+        return s.encode("utf-8")
+
+
+def _unpack_addr(b: bytes) -> str:
+    if not b:
+        return ""
+    if len(b) in (4, 16):
+        return str(ipaddress.ip_address(b))
+    return b.decode("utf-8", errors="replace")
+
+
+def _cidr_to_ip_prefix(cidr: str) -> dict:
+    net = ipaddress.ip_network(cidr, strict=False)
+    return {
+        "prefix_address": {"addr": net.network_address.packed, "if_name": None},
+        "prefix_length": net.prefixlen,
+    }
+
+
+def _ip_prefix_to_cidr(v) -> str:
+    addr = v["prefix_address"]["addr"]
+    return f"{ipaddress.ip_address(addr)}/{v['prefix_length']}"
+
+
+# openr/if/Network.thrift:61 IpPrefix {1: BinaryAddress prefixAddress,
+# 2: i16 prefixLength}
+IP_PREFIX = StructSpec(
+    "IpPrefix",
+    None,
+    (
+        Field(1, "prefix_address", ("struct", BINARY_ADDRESS)),
+        Field(2, "prefix_length", T_I16),
+    ),
+)
+
+# openr/if/Network.thrift:48 MplsAction {1: MplsActionCode action,
+# 2: optional swapLabel, 3: optional pushLabels (bottom of stack first)}
+MPLS_ACTION = StructSpec(
+    "MplsAction",
+    None,
+    (
+        Field(1, "action", T_I32),
+        Field(2, "swap_label", T_I32, optional=True),
+        Field(3, "push_labels", ("list", T_I32), optional=True),
+    ),
+)
+
+# openr/if/Network.thrift:66 NextHopThrift {1: BinaryAddress address,
+# 2: weight, 3: optional mplsAction, 51: metric, 53: optional area,
+# 54: optional neighborNodeName} — wire dict form; the repo NextHop
+# carries address/if_name separately and they merge into BinaryAddress
+NEXT_HOP = StructSpec(
+    "NextHopThrift",
+    None,
+    (
+        Field(1, "address", ("struct", BINARY_ADDRESS)),
+        Field(2, "weight", T_I32, default=0),
+        Field(3, "mpls_action", ("struct", MPLS_ACTION), optional=True),
+        Field(51, "metric", T_I32, default=0),
+        Field(53, "area", T_STRING, optional=True, dec=lambda b: b.decode()),
+        Field(
+            54,
+            "neighbor_node_name",
+            T_STRING,
+            optional=True,
+            dec=lambda b: b.decode(),
+        ),
+    ),
+)
+
+
+def _nh_to_wire(nh) -> dict:
+    action = None
+    if nh.mpls_action is not None:
+        action = {
+            "action": int(nh.mpls_action.action),
+            "swap_label": nh.mpls_action.swap_label,
+            "push_labels": (
+                list(nh.mpls_action.push_labels)
+                if nh.mpls_action.push_labels is not None
+                else None
+            ),
+        }
+    return {
+        "address": {
+            "addr": _pack_addr(nh.address),
+            "if_name": nh.if_name,
+        },
+        "weight": nh.weight,
+        "mpls_action": action,
+        "metric": nh.metric,
+        "area": nh.area,
+        "neighbor_node_name": nh.neighbor_node_name,
+    }
+
+
+def _wire_to_nh(v):
+    addr = v["address"]["addr"]
+    action = None
+    if v.get("mpls_action") is not None:
+        a = v["mpls_action"]
+        action = T.MplsAction(
+            action=T.MplsActionCode(a["action"]),
+            swap_label=a.get("swap_label"),
+            push_labels=(
+                tuple(a["push_labels"])
+                if a.get("push_labels") is not None
+                else None
+            ),
+        )
+    return T.NextHop(
+        address=_unpack_addr(addr),
+        if_name=v["address"].get("if_name"),
+        metric=v.get("metric", 0),
+        weight=v.get("weight", 0),
+        area=v.get("area"),
+        neighbor_node_name=v.get("neighbor_node_name"),
+        mpls_action=action,
+    )
+
+
+def _nhs_enc(nhs):
+    return [_nh_to_wire(nh) for nh in nhs]
+
+
+def _nhs_dec(ws):
+    return [_wire_to_nh(w) for w in ws]
+
+
+# openr/if/Network.thrift:122 UnicastRoute {1: IpPrefix dest,
+# 4: list<NextHopThrift> nextHops}
+UNICAST_ROUTE = StructSpec(
+    "UnicastRoute",
+    T.UnicastRoute,
+    (
+        Field(
+            1,
+            "dest",
+            ("struct", IP_PREFIX),
+            enc=_cidr_to_ip_prefix,
+            dec=_ip_prefix_to_cidr,
+        ),
+        Field(
+            4,
+            "next_hops",
+            ("list", ("struct", NEXT_HOP)),
+            enc=_nhs_enc,
+            dec=_nhs_dec,
+            default=[],
+        ),
+    ),
+)
+
+# openr/if/Network.thrift:99 MplsRoute {1: i32 topLabel,
+# 4: list<NextHopThrift> nextHops}
+MPLS_ROUTE = StructSpec(
+    "MplsRoute",
+    T.MplsRoute,
+    (
+        Field(1, "top_label", T_I32),
+        Field(
+            4,
+            "next_hops",
+            ("list", ("struct", NEXT_HOP)),
+            enc=_nhs_enc,
+            dec=_nhs_dec,
+            default=[],
+        ),
+    ),
+)
+
+# openr/if/Types.thrift:1003 RouteDatabase {1: thisNodeName,
+# 3: optional perfEvents, 4: unicastRoutes, 5: mplsRoutes}
+ROUTE_DATABASE = StructSpec(
+    "RouteDatabase",
+    T.RouteDatabase,
+    (
+        Field(1, "this_node_name", T_STRING, dec=lambda b: b.decode(), default=""),
+        Field(3, "perf_events", ("struct", PERF_EVENTS), optional=True),
+        Field(
+            4,
+            "unicast_routes",
+            ("list", ("struct", UNICAST_ROUTE)),
+            default=[],
+        ),
+        Field(
+            5,
+            "mpls_routes",
+            ("list", ("struct", MPLS_ROUTE)),
+            default=[],
+        ),
+    ),
+)
+
+
+# ---------------------------------------------------------------------------
 # strict message envelope + framed transport
 # ---------------------------------------------------------------------------
 

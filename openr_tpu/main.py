@@ -340,6 +340,8 @@ class OpenrDaemon:
                 host=self.config.listen_addr,
                 port=max(self.config.thrift_shim_port, 0),
                 node_name=self.config.node_name,
+                decision=self.decision,
+                fib=self.fib,
             )
             self.thrift_shim.run()
         if self.watchdog is not None:

@@ -603,6 +603,12 @@ class TestWarmStart:
         )
         v2 = cache.view(ls, dests)
         assert not v2.warm
+        # hint routing follows what actually ran: the cold (ELL) sweep
+        # count must land in _hints, never in _warm_hints (an inherited
+        # cold count there would oversize every later banded warm seed)
+        key = (v2.csr.n_nodes, v2.csr.n_edges)
+        assert key not in cache._warm_hints
+        assert cache._hints.get(key) == v2.sweep_hint
 
     def test_dest_change_blocks_warm(self):
         ls = self.ring_ls()

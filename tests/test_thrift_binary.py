@@ -19,9 +19,15 @@ from openr_tpu.interop.shim import ThriftBinaryShim
 from openr_tpu.types import (
     Adjacency,
     AdjacencyDatabase,
+    MplsAction,
+    MplsActionCode,
+    MplsRoute,
+    NextHop,
     PerfEvent,
     PerfEvents,
     Publication,
+    RouteDatabase,
+    UnicastRoute,
     Value,
 )
 
@@ -454,3 +460,231 @@ class TestDaemonShimWiring:
             assert reply["success"] == b"shimw"
         finally:
             daemon.stop()
+
+
+class TestRouteStructRoundTrips:
+    """Round-5 shim extension: Network.thrift route structs
+    (IpPrefix/NextHopThrift/UnicastRoute/MplsRoute/RouteDatabase)."""
+
+    def test_ip_prefix_golden(self):
+        # IpPrefix{BinaryAddress{addr=4B v4}, prefixLength=24}: field 1
+        # struct (inner: field 1 string 4 bytes), field 2 i16
+        enc = tb.encode_struct(
+            tb.UNICAST_ROUTE,
+            UnicastRoute(dest="10.1.2.0/24"),
+        )
+        want = (
+            b"\x0c\x00\x01"  # field 1 (dest) struct
+            b"\x0c\x00\x01"  # IpPrefix field 1 (prefixAddress) struct
+            b"\x0b\x00\x01\x00\x00\x00\x04\x0a\x01\x02\x00"  # addr
+            b"\x00"  # end BinaryAddress
+            b"\x06\x00\x02\x00\x18"  # field 2 (prefixLength) i16 = 24
+            b"\x00"  # end IpPrefix
+            b"\x0f\x00\x04\x0c\x00\x00\x00\x00"  # field 4 nextHops: empty
+            b"\x00"  # end UnicastRoute
+        )
+        assert enc == want
+
+    def test_unicast_route_round_trip_with_mpls_push(self):
+        route = UnicastRoute(
+            dest="fc00:1::/64",
+            next_hops=[
+                NextHop(
+                    address="fe80::1",
+                    if_name="eth0",
+                    metric=20,
+                    weight=0,
+                    area="0",
+                    neighbor_node_name="peer-1",
+                    mpls_action=MplsAction(
+                        action=MplsActionCode.PUSH,
+                        push_labels=(100, 200),
+                    ),
+                ),
+                NextHop(address="fe80::2", metric=30),
+            ],
+        )
+        back = tb.decode_struct(
+            tb.UNICAST_ROUTE, tb.encode_struct(tb.UNICAST_ROUTE, route)
+        )
+        assert back == route
+
+    def test_mpls_route_round_trip_swap_and_php(self):
+        for action in (
+            MplsAction(action=MplsActionCode.SWAP, swap_label=77),
+            MplsAction(action=MplsActionCode.PHP),
+        ):
+            route = MplsRoute(
+                top_label=1201,
+                next_hops=[
+                    NextHop(
+                        address="fe80::9", if_name="po1", mpls_action=action
+                    )
+                ],
+            )
+            back = tb.decode_struct(
+                tb.MPLS_ROUTE, tb.encode_struct(tb.MPLS_ROUTE, route)
+            )
+            assert back == route
+
+    def test_route_database_round_trip(self):
+        db = RouteDatabase(
+            this_node_name="nodeA",
+            unicast_routes=[
+                UnicastRoute(
+                    dest="192.168.0.0/16",
+                    next_hops=[NextHop(address="10.0.0.1", metric=1)],
+                )
+            ],
+            mpls_routes=[
+                MplsRoute(
+                    top_label=5,
+                    next_hops=[NextHop(address="10.0.0.2")],
+                )
+            ],
+        )
+        back = tb.decode_struct(
+            tb.ROUTE_DATABASE, tb.encode_struct(tb.ROUTE_DATABASE, db)
+        )
+        assert back == db
+
+
+class TestShimRouteExchange:
+    """The Decision/Fib query surface over the wire: a converged
+    two-daemon pair answers stock-shaped thrift-binary route calls."""
+
+    @pytest.fixture
+    def pair(self):
+        from openr_tpu.kvstore import InProcessTransport
+        from openr_tpu.main import OpenrDaemon
+        from openr_tpu.spark import MockIoProvider
+        from openr_tpu.types import LinkEvent, PrefixEntry, PrefixType
+        from tests.test_system import FIB_CLIENT, make_config, wait_for
+
+        fabric = MockIoProvider()
+        kv = InProcessTransport()
+        daemons = []
+        for name in ("rshim-0", "rshim-1"):
+            cfg = make_config(name, ctrl_port=0)
+            if name == "rshim-0":
+                cfg.thrift_shim_port = -1
+            addr = f"fe80::{name}"
+            d = OpenrDaemon(
+                cfg,
+                io_provider=fabric.endpoint(name),
+                kvstore_transport=kv.bind(addr),
+                spark_v6_addr=addr,
+            )
+            kv.register(addr, d.kvstore)
+            daemons.append(d)
+        for d in daemons:
+            d.start()
+        fabric.connect("rshim-0", "veth0", "rshim-1", "veth1")
+        daemons[0].netlink_events_queue.push(LinkEvent("veth0", 1, True))
+        daemons[1].netlink_events_queue.push(LinkEvent("veth1", 1, True))
+        daemons[1].prefix_manager.advertise_prefixes(
+            PrefixType.LOOPBACK, [PrefixEntry(prefix="fc01::/64")]
+        )
+        assert wait_for(
+            lambda: "fc01::/64"
+            in daemons[0].fib_agent.unicast.get(FIB_CLIENT, {}),
+            timeout=30,
+        )
+        yield daemons
+        for d in daemons:
+            d.stop()
+
+    def test_get_route_db_over_the_wire(self, pair):
+        port = pair[0].thrift_shim.port
+        db = _call_ok(
+            port, "getRouteDb", 7, b"\x00", ("struct", tb.ROUTE_DATABASE)
+        )
+        assert db.this_node_name == "rshim-0"
+        dests = {r.dest for r in db.unicast_routes}
+        assert "fc01::/64" in dests
+        route = next(r for r in db.unicast_routes if r.dest == "fc01::/64")
+        assert route.next_hops[0].neighbor_node_name == "rshim-1"
+        # node labels -> MPLS routes present with real actions
+        assert any(m.next_hops for m in db.mpls_routes) or not db.mpls_routes
+
+    def test_get_route_db_computed_any_node(self, pair):
+        port = pair[0].thrift_shim.port
+        args = tb.encode_struct(
+            tb.StructSpec(
+                "node_args",
+                None,
+                (tb.Field(1, "node_name", tb.T_STRING),),
+            ),
+            {"node_name": "rshim-1"},
+        )
+        db = _call_ok(
+            port,
+            "getRouteDbComputed",
+            8,
+            args,
+            ("struct", tb.ROUTE_DATABASE),
+        )
+        assert db.this_node_name == "rshim-1"
+        # rshim-1 advertises fc01::/64 itself: no unicast route to it,
+        # but its own perspective must still compute (possibly empty)
+        assert all(r.dest != "fc01::/64" for r in db.unicast_routes)
+
+    def test_get_unicast_routes_filtered(self, pair):
+        port = pair[0].thrift_shim.port
+        args = tb.encode_struct(
+            tb.StructSpec(
+                "prefixes_args",
+                None,
+                (tb.Field(1, "prefixes", ("list", tb.T_STRING)),),
+            ),
+            {"prefixes": ["fc01::/64"]},
+        )
+        routes = _call_ok(
+            port,
+            "getUnicastRoutesFiltered",
+            9,
+            args,
+            ("list", ("struct", tb.UNICAST_ROUTE)),
+        )
+        assert [r.dest for r in routes] == ["fc01::/64"]
+        # and the unfiltered variant returns at least as much
+        all_routes = _call_ok(
+            port,
+            "getUnicastRoutes",
+            10,
+            b"\x00",
+            ("list", ("struct", tb.UNICAST_ROUTE)),
+        )
+        assert {r.dest for r in routes} <= {r.dest for r in all_routes}
+
+    def test_get_mpls_routes_matches_fib(self, pair):
+        port = pair[0].thrift_shim.port
+        mpls = _call_ok(
+            port,
+            "getMplsRoutes",
+            11,
+            b"\x00",
+            ("list", ("struct", tb.MPLS_ROUTE)),
+        )
+        _, fib_mpls = pair[0].fib.get_route_db()
+        assert {m.top_label for m in mpls} == {
+            m.top_label for m in fib_mpls
+        }
+        if mpls:
+            one = mpls[0].top_label
+            args = tb.encode_struct(
+                tb.StructSpec(
+                    "labels_args",
+                    None,
+                    (tb.Field(1, "labels", ("list", tb.T_I32)),),
+                ),
+                {"labels": [one]},
+            )
+            filtered = _call_ok(
+                port,
+                "getMplsRoutesFiltered",
+                12,
+                args,
+                ("list", ("struct", tb.MPLS_ROUTE)),
+            )
+            assert [m.top_label for m in filtered] == [one]
