@@ -1287,11 +1287,20 @@ class SpfSolver:
                     continue
                 if min_sources is not None and len(dests) < min_sources:
                     continue
+            cached = self.fleet.is_warm(ls, dests)
             view = self.fleet.view(
                 ls, dests, csr=mirror(ls) if mirror is not None else None
             )
             if view is not None:
                 views[area] = view
+                if not cached:
+                    # fb303-style observability: operators watch the
+                    # warm-start hit rate of fleet rebuilds
+                    self._bump(
+                        "decision.fleet_rebuild_warm"
+                        if view.warm
+                        else "decision.fleet_rebuild_cold"
+                    )
         return views
 
     def any_node_route_db(

@@ -571,6 +571,27 @@ class TestWarmStart:
             {"0": ls}, ps, nodes=[f"r{i:03d}" for i in (0, 1, 2, 31, 63)]
         )
 
+    def test_rebuild_counters_track_warm_hits(self):
+        from openr_tpu.decision.spf_solver import DeviceSpfBackend, SpfSolver
+
+        ls = self.ring_ls()
+        ps = prefix_state_with(("r063", "0", PrefixEntry(prefix=PFX)))
+        solver = SpfSolver(
+            "r000",
+            spf_backend=DeviceSpfBackend(
+                min_device_nodes=1, min_device_sources=1
+            ),
+        )
+        solver.fleet_route_dbs({"0": ls}, ps, nodes=["r000"])
+        assert solver.counters.get("decision.fleet_rebuild_cold") == 1
+        assert "decision.fleet_rebuild_warm" not in solver.counters
+        self._set_node(ls, 0, metric=lambda a, b: 5 if b == 1 else 20)
+        solver.fleet_route_dbs({"0": ls}, ps, nodes=["r000"])
+        assert solver.counters.get("decision.fleet_rebuild_warm") == 1
+        # a cached re-read computes nothing and bumps nothing
+        solver.fleet_route_dbs({"0": ls}, ps, nodes=["r000"])
+        assert solver.counters.get("decision.fleet_rebuild_warm") == 1
+
     def test_overload_set_cold_clear_warm(self):
         ls = self.ring_ls()
         ps = prefix_state_with(("r063", "0", PrefixEntry(prefix=PFX)))
