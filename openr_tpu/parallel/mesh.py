@@ -140,3 +140,97 @@ def whatif_step_sharded(mesh: Mesh):
     so scaling what-if fleets over chips needs no collectives beyond the
     optional node-axis sharding of the distance state."""
     return _step_sharded(mesh, masked=True)
+
+
+def fleet_product_sharded(
+    mesh: Mesh,
+    n_sweeps: int,
+    n_words: int,
+    depth: int = 0,
+    resid_rounds: int = 1,
+    small_dist: bool = True,
+    chord_mode: bool = True,
+):
+    """Jitted mesh-sharded reduced all-sources product (the round-4/5
+    flagship, ops.allsources): the DESTINATION axis P shards over the
+    mesh batch axis.
+
+    Sharding layout:
+        dest_ids [P]          P("batch")
+        dist     [N, P]       P(None, "batch")
+        bitmap   [N, P, W]    P(None, "batch", None)
+        graph tables / edge state   replicated
+
+    Each shard runs the full banded reverse relax over its own P/D
+    destination columns — rolls along the (replicated) node axis and
+    residual row gathers are both shard-local, so the relax and the
+    bitmap pass emit NO collectives; the only cross-shard ops are the
+    verdict's scalar reductions (all(v == d), plus the uint16
+    saturation max when small_dist).  This is the multi-chip path for
+    fleet products whose destination count outgrows one chip's HBM (the
+    [N, P] product + [N, P, W] bitmaps at P=8192/100k nodes is ~4.8 GB —
+    two chips' worth with workspace).
+
+    The step body is the SAME single-device pipeline
+    (ops.banded.spf_forward_banded want_dag=False/raw_u16/native-layout
+    + ops.allsources.ecmp_bitmap_from_reverse_dist) under sharding
+    constraints, so semantics changes there reach this path for free."""
+    from ..ops import allsources as asrc
+    from ..ops.banded import spf_forward_banded
+
+    s_dest = NamedSharding(mesh, P("batch"))
+    s_dist = NamedSharding(mesh, P(None, "batch"))
+    s_bitmap = NamedSharding(mesh, P(None, "batch", None))
+    s_repl = NamedSharding(mesh, P())
+
+    def step(
+        dest_ids,  # [P] int32, sharded
+        bg,  # BandedGraph pytree, replicated
+        r_edge_src,
+        r_edge_dst,
+        r_edge_metric,
+        r_edge_up,
+        node_overloaded,
+        out,  # OutEll pytree, replicated
+        f_edge_metric,
+        f_edge_up,
+    ):
+        dist, _, ok = spf_forward_banded(
+            dest_ids,
+            bg,
+            r_edge_src,
+            r_edge_dst,
+            r_edge_metric,
+            r_edge_up,
+            node_overloaded,
+            n_supersweeps=n_sweeps,
+            depth=depth,
+            resid_rounds=resid_rounds,
+            small_dist=small_dist,
+            want_dag=False,
+            chord_mode=chord_mode,
+            raw_u16=True,
+            transpose=False,
+        )
+        dist = jax.lax.with_sharding_constraint(dist, s_dist)
+        bitmap = asrc.ecmp_bitmap_from_reverse_dist(
+            dist, out, f_edge_metric, f_edge_up, node_overloaded, n_words
+        )
+        return dist, bitmap, ok
+
+    return jax.jit(
+        step,
+        in_shardings=(
+            s_dest,
+            s_repl,
+            s_repl,
+            s_repl,
+            s_repl,
+            s_repl,
+            s_repl,
+            s_repl,
+            s_repl,
+            s_repl,
+        ),
+        out_shardings=(s_dist, s_bitmap, s_repl),
+    )
