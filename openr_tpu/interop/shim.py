@@ -125,6 +125,16 @@ _LABELS_ARGS = tb.StructSpec(
     None,
     (tb.Field(1, "labels", ("list", tb.T_I32), default=[]),),
 )
+# fb303 getRegexCounters(1: string regex)
+_REGEX_ARGS = tb.StructSpec(
+    "regex_args",
+    None,
+    (
+        tb.Field(
+            1, "regex", tb.T_STRING, dec=lambda b: b.decode(), default=".*"
+        ),
+    ),
+)
 
 
 class ThriftBinaryShim(OpenrEventBase):
@@ -138,6 +148,7 @@ class ThriftBinaryShim(OpenrEventBase):
         node_name: str = "",
         decision=None,
         fib=None,
+        counters_fn=None,
     ) -> None:
         super().__init__(name="thrift-shim")
         self.kvstore = kvstore
@@ -146,6 +157,9 @@ class ThriftBinaryShim(OpenrEventBase):
         self.node_name = node_name
         self.decision = decision
         self.fib = fib
+        # () -> dict[str, int]: the daemon passes the ctrl server's
+        # merged per-module counter dump (fb303 getCounters semantics)
+        self.counters_fn = counters_fn
         self._server: Optional[asyncio.AbstractServer] = None
 
     def _fib(self):
@@ -304,6 +318,27 @@ class ThriftBinaryShim(OpenrEventBase):
                     for nm, ps in peers.items()
                 }
                 return self._reply(name, seqid, _PEERS_MAP, wire)
+            if name in ("getCounters", "getRegexCounters"):
+                # fb303 base-service surface stock monitoring tooling
+                # polls (map<string, i64>)
+                import re as _re
+
+                if name == "getRegexCounters":
+                    args = tb.read_struct(r, _REGEX_ARGS)
+                    pat = _re.compile(args["regex"])
+                else:
+                    tb.read_struct(r, _EMPTY_ARGS)
+                    pat = None
+                if self.counters_fn is None:
+                    raise RuntimeError("counters source not attached")
+                counters = {
+                    k: int(v)
+                    for k, v in self.counters_fn().items()
+                    if pat is None or pat.search(k)
+                }
+                return self._reply(
+                    name, seqid, ("map", tb.T_STRING, tb.T_I64), counters
+                )
             if name == "getRouteDb":
                 # reference: routes as tracked by the FIB module
                 # (OpenrCtrl.thrift:298)

@@ -342,6 +342,7 @@ class OpenrDaemon:
                 node_name=self.config.node_name,
                 decision=self.decision,
                 fib=self.fib,
+                counters_fn=self.ctrl_server.handler._all_counters,
             )
             self.thrift_shim.run()
         if self.watchdog is not None:

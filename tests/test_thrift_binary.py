@@ -657,6 +657,39 @@ class TestShimRouteExchange:
         )
         assert {r.dest for r in routes} <= {r.dest for r in all_routes}
 
+    def test_get_counters_over_the_wire(self, pair):
+        port = pair[0].thrift_shim.port
+        counters = _call_ok(
+            port,
+            "getCounters",
+            13,
+            b"\x00",
+            ("map", tb.T_STRING, tb.T_I64),
+            dec=lambda m: {k.decode(): v for k, v in m.items()},
+        )
+        assert counters.get("decision.adj_db_update", 0) >= 1
+        # regex variant filters server-side (fb303 getRegexCounters)
+        args = tb.encode_struct(
+            tb.StructSpec(
+                "regex_args",
+                None,
+                (tb.Field(1, "regex", tb.T_STRING),),
+            ),
+            {"regex": "^decision\\."},
+        )
+        filtered = _call_ok(
+            port,
+            "getRegexCounters",
+            14,
+            args,
+            ("map", tb.T_STRING, tb.T_I64),
+            dec=lambda m: {k.decode(): v for k, v in m.items()},
+        )
+        assert filtered and all(
+            k.startswith("decision.") for k in filtered
+        )
+        assert set(filtered) <= set(counters)
+
     def test_get_mpls_routes_matches_fib(self, pair):
         port = pair[0].thrift_shim.port
         mpls = _call_ok(
