@@ -3,8 +3,27 @@
 from __future__ import annotations
 
 import json
+import os
 import time
-from typing import Callable
+from typing import Callable, Optional
+
+# Peak HBM bandwidth of the bench device, bytes/s.  Default is the
+# v5e figure (819 GB/s per chip); override with OPENR_PEAK_HBM_BW for
+# other parts so utilization fractions stay honest across hardware.
+PEAK_HBM_BW = float(os.environ.get("OPENR_PEAK_HBM_BW", 819e9))
+
+
+def achieved_bw_frac(
+    bytes_moved: Optional[float], wall_ms: Optional[float]
+) -> Optional[float]:
+    """Fraction of peak HBM bandwidth achieved: bytes-moved /
+    (wall x peak BW).  The utilization lens on every device row — a
+    memory-bound kernel near 1.0 is done; a small fraction says the
+    wall is dispatch/latency, not bandwidth.  None when either input is
+    missing/degenerate (e.g. a row that never timed)."""
+    if not bytes_moved or not wall_ms or wall_ms <= 0:
+        return None
+    return round(float(bytes_moved) / (wall_ms * 1e-3 * PEAK_HBM_BW), 4)
 
 
 def measure_ms(fn: Callable[[], None], reps: int = 3, warmup: int = 1) -> float:

@@ -93,6 +93,106 @@ def _improvement_only(
     return bool(np.all(new_met[pos] <= old_met))
 
 
+def _in_sorted(keys: np.ndarray, q: np.ndarray) -> np.ndarray:
+    """Vectorized membership of q in the sorted key array."""
+    if len(keys) == 0:
+        return np.zeros(q.shape, dtype=bool)
+    pos = np.searchsorted(keys, q)
+    pos_c = np.minimum(pos, len(keys) - 1)
+    return (pos < len(keys)) & (keys[pos_c] == q)
+
+
+def _worsened_masks(prev: "FleetRouteView", new_keys, new_met, new_ov):
+    """Per-reverse-slot masks of WORSENED forward edges, in the layout of
+    the previous view's reverse runner (bg.resid slots + band
+    positions) — the seed of the affected-set propagation
+    (ops.banded.affected_mask).
+
+    Worsened means the edge can only LENGTHEN paths that used it:
+    - old usable directed pair now unusable (link down / all parallel
+      links down),
+    - old pair still usable but its min metric increased,
+    - transit through a newly-overloaded node (drain): every reverse
+      edge SOURCED at that node — conservatively including the
+      destination-row exception, which only over-marks.
+    Edges that improved or appeared are NOT worsened: the old product
+    stays an upper bound wherever no worsened edge is on every old
+    shortest path, even when improvements happen in the same delta
+    (improvements only loosen the bound, and the relax fixes looseness;
+    the verification certifies exactness either way)."""
+    old_keys, old_met = prev._edge_keys, prev._edge_met
+    present = _in_sorted(new_keys, old_keys)
+    pos = np.minimum(
+        np.searchsorted(new_keys, old_keys), max(len(new_keys) - 1, 0)
+    )
+    worse = ~present
+    if len(new_keys):
+        worse |= present & (new_met[pos] > old_met)
+    bad_keys = old_keys[worse]  # sorted (subset of sorted old_keys)
+    newly_ov = new_ov & ~prev._overloaded
+    bg = prev._runner.bg
+    n = bg.n_nodes
+    rn = np.asarray(bg.resid_nbr)
+    re_ = np.asarray(bg.resid_eid)
+    # reverse edge u -> v is forward edge v -> u: forward key (v, u)
+    v_ids = np.arange(n, dtype=np.int64)
+    qk = (v_ids[:, None] << 32) | rn.astype(np.int64)
+    worsened_resid = (re_ >= 0) & (
+        _in_sorted(bad_keys, qk) | newly_ov[rn]
+    )
+    be = np.asarray(bg.band_eid)
+    rows = []
+    for b, c in enumerate(bg.offsets):
+        u = (v_ids - c) % n
+        qk = (v_ids << 32) | u
+        rows.append(
+            (be[b] >= 0) & (_in_sorted(bad_keys, qk) | newly_ov[u])
+        )
+    return worsened_resid, np.stack(rows)
+
+
+def _affected_init(prev: "FleetRouteView", new: "FleetRouteView"):
+    """Device init for a worsening-direction warm start: the previous
+    distances with every possibly-affected entry re-set to INF, or None
+    when the affected-set propagation could not certify its fixpoint
+    (the caller must cold-start).
+
+    Safety argument (the worsening mirror of _improvement_only): an
+    entry is re-relaxed from INF whenever ANY old tight chain into it
+    crosses a worsened edge (affected_mask, certified fixpoint), so
+    every kept entry has an old shortest path that survives un-worsened
+    — its old value is still an elementwise UPPER bound in the new
+    graph — and the warm relax plus verification then reproduce the
+    cold fixed point bit-for-bit (ops.banded.spf_forward_banded)."""
+    import jax.numpy as jnp
+
+    from ..ops.banded import affected_mask
+
+    runner = prev._runner
+    if runner is None or runner.bg is None or prev._dist_dev is None:
+        return None
+    worsened_resid, worsened_band = _worsened_masks(
+        prev, new._edge_keys, new._edge_met, new._overloaded
+    )
+    small = prev._dist_dev.dtype == np.uint16
+    _, _, r_met, r_up, r_ov = runner.call_arrays()
+    aff, done = affected_mask(
+        prev._dist_dev,
+        runner.bg,
+        r_up,
+        r_met,
+        r_ov,
+        jnp.asarray(worsened_resid),
+        jnp.asarray(worsened_band),
+        small_dist=bool(small),
+        max_iters=128,
+    )
+    if not bool(done):
+        return None
+    inf = jnp.uint16(INF16) if small else jnp.int32(INF32)
+    return jnp.where(aff, inf, prev._dist_dev[: runner.bg.n_nodes])
+
+
 def _reverse_runner(csr, hint: Optional[int] = None):
     """SpfRunner over the REVERSED directed edges of a CsrTopology
     snapshot (same construction as benchmarks.synthetic.reversed_topology,
@@ -166,7 +266,12 @@ class FleetRouteView:
         self._rows: dict[int, np.ndarray] = {}  # node id -> [P] int32
         self.converged = False
         self.warm = False  # computed from a previous view's distances
+        # None | "improve" | "worsen" — which warm gate admitted the seed
+        self.warm_mode: Optional[str] = None
         self.sweep_hint: Optional[int] = None
+        self._runner = None  # retained for the NEXT view's worsening
+        #   warm start: affected-set propagation runs over THIS view's
+        #   reverse graph and distances (_affected_init)
 
     # -- device round --------------------------------------------------------
 
@@ -175,26 +280,32 @@ class FleetRouteView:
         hint_seed: Optional[int] = None,
         init_from: Optional["FleetRouteView"] = None,
         warm_seed: Optional[int] = None,
+        down_from: Optional["FleetRouteView"] = None,
     ) -> None:
-        """One device ROUND — the P-source reverse relax plus the ECMP
-        bitmap pass (two pipelined dispatches; reduced_all_sources
-        defaults to unfused on the round-5 measurement that the
-        single-program fusion schedules worse).  `hint_seed` carries the
-        previous view's learned COLD sweep count across topology
-        versions (same-shape seeding).
+        """One device ROUND — the P-source reverse relax with the ECMP
+        bitmap folded into its final verification supersweep
+        (reduced_all_sources' fused progressive fast path; the product
+        is read once and convergence is certified on-device).
+        `hint_seed` carries the previous view's learned COLD sweep
+        count across topology versions (same-shape seeding, legacy
+        fixed-sweep paths only).
 
         `init_from` warm-starts the relax from a previous view's device
         distances.  The CALLER (FleetViewCache.view) must have proven
         the improvement-only gate (_improvement_only) plus node/dest
         universe equality — an un-gated init can silently fix-point
         below the true distances (ops.banded.spf_forward_banded).
-        `warm_seed` is the sweep seed used ONLY when the warm path
-        actually engages; whether it does depends on the runner's
-        bandedness, which is known only after the runner is built here
-        (the ELL fallback ignores dist0 and must keep the cold seed, or
-        adapt() would pay doubling retries of full-P dispatches from an
-        undersized warm default).  Callers read `self.warm` afterwards
-        to route hint harvesting."""
+        `down_from` is the WORSENING-direction counterpart: the same
+        universe equality, but the change removed/worsened edges — the
+        seed is the previous distances with the certified affected set
+        re-set to INF (_affected_init); when the certification fails
+        the run silently cold-starts.  `warm_seed` is the sweep seed
+        used ONLY when a warm path actually engages; whether it does
+        depends on the runner's bandedness, which is known only after
+        the runner is built here (the ELL fallback ignores dist0 and
+        must keep the cold seed).  Callers read `self.warm` /
+        `self.warm_mode` afterwards to route hint harvesting and
+        counters."""
         from ..ops import allsources as asrc
 
         dest_ids = np.asarray(
@@ -208,14 +319,26 @@ class FleetRouteView:
             self.csr.n_nodes,
             out_slot=self.csr.out_slot,
         )
-        init = init_from._dist_dev if init_from is not None else None
-        if init is not None and runner.bg is None:
+        init = None
+        self.warm_mode = None
+        if runner.bg is not None:
             # the ELL fallback ignores dist0 (cold run): claiming warm
             # would mislabel the view AND poison _warm_hints with a cold
             # sweep count
-            init = None
-        elif init is not None and warm_seed is not None:
+            if init_from is not None:
+                init = init_from._dist_dev
+                self.warm_mode = "improve"
+            elif down_from is not None:
+                init = _affected_init(down_from, self)
+                if init is not None:
+                    self.warm_mode = "worsen"
+        if init is not None and warm_seed is not None:
             runner.hint = warm_seed
+        maps = (
+            asrc.build_epilogue_maps(runner.bg, self._out)
+            if runner.bg is not None
+            else None
+        )
         dist, bitmap, ok = asrc.reduced_all_sources(
             dest_ids,
             runner,
@@ -224,13 +347,32 @@ class FleetRouteView:
             self.csr.edge_up,
             self.csr.node_overloaded,
             init_dist=init,
+            maps=maps,
         )
+        if not bool(ok) and init is not None:
+            # the warm relax exhausted its block budget without the
+            # on-device certificate: the seed bought nothing — pay the
+            # cold run rather than serve an uncertified product
+            init = None
+            self.warm_mode = None
+            if hint_seed is not None:
+                runner.hint = hint_seed
+            dist, bitmap, ok = asrc.reduced_all_sources(
+                dest_ids,
+                runner,
+                self._out,
+                self.csr.edge_metric,
+                self.csr.edge_up,
+                self.csr.node_overloaded,
+                maps=maps,
+            )
         assert bool(ok), "fleet reverse SSSP did not reach its fixed point"
         self._dist_dev = dist
         self._bitmap_dev = bitmap
         self.converged = True
         self.warm = init is not None
         self.sweep_hint = runner.hint
+        self._runner = runner
 
     # -- host queries --------------------------------------------------------
 
@@ -351,13 +493,16 @@ class FleetViewCache:
         """Computed view for this (version, dests); None when empty.
 
         A rebuild WARM-STARTS from the previous view's device distances
-        when the change since was improvement-only (link up, metric
-        decrease, overload clear) over the same node/dest universe —
-        the upper-bound condition ops.banded.spf_forward_banded
-        requires.  The flap-recovery half of reconvergence then pays a
-        few relax sweeps instead of the full cold count; worsening
-        changes (link down, metric increase, drain) cold-start exactly
-        as before."""
+        in BOTH change directions over the same node/dest universe:
+        improvement-only changes (link up, metric decrease, overload
+        clear) seed the full previous product — the upper-bound
+        condition ops.banded.spf_forward_banded requires — while
+        worsening/mixed changes (link down, metric increase, drain)
+        seed the previous product with the certified affected set
+        re-set to INF (_affected_init), the mirror-image upper bound.
+        Either way reconvergence pays a few relax sweeps instead of the
+        full cold count; only universe changes and uncertifiable
+        affected sets still cold-start."""
         if not dest_names:
             return None
         if self.is_warm(ls, dest_names):
@@ -372,6 +517,7 @@ class FleetViewCache:
         view = FleetRouteView(csr, dest_names)
         key = (csr.n_nodes, csr.n_edges)
         init_from = None
+        down_from = None
         if (
             prev is not None
             and prev.converged
@@ -379,16 +525,18 @@ class FleetViewCache:
             and prev.dest_names == view.dest_names
             and prev._node_id == view._node_id
             and prev._overloaded.shape == view._overloaded.shape
-            and _improvement_only(
+        ):
+            if _improvement_only(
                 prev._edge_keys,
                 prev._edge_met,
                 prev._overloaded,
                 view._edge_keys,
                 view._edge_met,
                 view._overloaded,
-            )
-        ):
-            init_from = prev
+            ):
+                init_from = prev
+            elif prev._runner is not None and prev._runner.bg is not None:
+                down_from = prev
         # cold seed always flows in; the warm seed applies only if the
         # warm path engages (compute() decides — ELL fallbacks stay
         # cold), and harvesting routes by what actually ran
@@ -396,6 +544,7 @@ class FleetViewCache:
             hint_seed=self._hints.get(key),
             init_from=init_from,
             warm_seed=self._warm_hints.get(key, 4),
+            down_from=down_from,
         )
         if view.sweep_hint is not None:
             store = self._warm_hints if view.warm else self._hints

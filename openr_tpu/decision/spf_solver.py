@@ -1295,12 +1295,16 @@ class SpfSolver:
                 views[area] = view
                 if not cached:
                     # fb303-style observability: operators watch the
-                    # warm-start hit rate of fleet rebuilds
+                    # warm-start hit rate of fleet rebuilds, split by
+                    # change direction (link-DOWN warm starts are the
+                    # newer, riskier gate)
                     self._bump(
                         "decision.fleet_rebuild_warm"
                         if view.warm
                         else "decision.fleet_rebuild_cold"
                     )
+                    if view.warm_mode == "worsen":
+                        self._bump("decision.fleet_rebuild_warm_down")
         return views
 
     def any_node_route_db(

@@ -435,11 +435,48 @@ class Fib(OpenrEventBase):
         return self.run_in_event_base_thread(_get).result()
 
     def get_unicast_routes(self, prefixes: Optional[list[str]] = None) -> list[UnicastRoute]:
+        """Reference: Fib::getUnicastRoutesFiltered (openr/fib/Fib.cpp:268).
+
+        Each filter entry is normalized through `ipaddress` (so
+        "fc01::0001/64" finds the route keyed "fc01::/64") and answered
+        by LONGEST-PREFIX MATCH: an exact (normalized) table hit wins,
+        otherwise the most-specific table route that COVERS the queried
+        prefix — so querying a host address returns its covering route,
+        never a silent miss on string inequality.  Malformed filter
+        entries match nothing; duplicates collapse (first occurrence
+        order preserved)."""
+
         def _get() -> list[UnicastRoute]:
             routes = self.route_state.unicast_routes
             if not prefixes:
                 return list(routes.values())
-            return [routes[p] for p in prefixes if p in routes]
+            table: list[tuple] = []
+            for key in routes:
+                try:
+                    table.append((ipaddress.ip_network(key, strict=False), key))
+                except ValueError:
+                    continue
+            out: list[UnicastRoute] = []
+            seen: set[str] = set()
+            for p in prefixes:
+                try:
+                    q = ipaddress.ip_network(p, strict=False)
+                except ValueError:
+                    continue
+                best_key = None
+                best_len = -1
+                for net, key in table:
+                    if (
+                        net.version == q.version
+                        and net.prefixlen <= q.prefixlen
+                        and q.network_address in net
+                        and net.prefixlen > best_len
+                    ):
+                        best_key, best_len = key, net.prefixlen
+                if best_key is not None and best_key not in seen:
+                    seen.add(best_key)
+                    out.append(routes[best_key])
+            return out
 
         return self.run_in_event_base_thread(_get).result()
 

@@ -39,6 +39,10 @@ from . import thrift_binary as tb
 log = logging.getLogger(__name__)
 
 MAX_FRAME = 64 * 1024 * 1024
+# getRegexCounters patterns run on the event loop against every counter
+# key — cap what one client can submit (generous: fb303 regexes in the
+# wild are tens of chars)
+MAX_COUNTER_REGEX_LEN = 1024
 
 # argument StructSpecs (module constants: the shim decodes at wire rate)
 _GET_ARGS = tb.StructSpec(
@@ -325,16 +329,39 @@ class ThriftBinaryShim(OpenrEventBase):
 
                 if name == "getRegexCounters":
                     args = tb.read_struct(r, _REGEX_ARGS)
-                    pat = _re.compile(args["regex"])
+                    regex = args.get("regex") or ""
+                    # the pattern runs on the daemon event loop against
+                    # every counter key: bound what one client can make
+                    # it cost.  Length-capped patterns over short keys
+                    # bound re backtracking; compile/match errors answer
+                    # as a thrift application exception instead of
+                    # killing the connection handler.
+                    if len(regex) > MAX_COUNTER_REGEX_LEN:
+                        raise RuntimeError(
+                            "counter regex longer than "
+                            f"{MAX_COUNTER_REGEX_LEN} chars"
+                        )
+                    try:
+                        pat = _re.compile(regex)
+                    except _re.error as exc:
+                        raise RuntimeError(f"bad counter regex: {exc}")
                 else:
                     tb.read_struct(r, _EMPTY_ARGS)
                     pat = None
                 if self.counters_fn is None:
                     raise RuntimeError("counters source not attached")
+
+                def _matches(key: str) -> bool:
+                    if pat is None:
+                        return True
+                    try:
+                        return pat.search(key) is not None
+                    except Exception:  # e.g. RecursionError on
+                        return False  # pathological nesting
                 counters = {
                     k: int(v)
                     for k, v in self.counters_fn().items()
-                    if pat is None or pat.search(k)
+                    if _matches(k)
                 }
                 return self._reply(
                     name, seqid, ("map", tb.T_STRING, tb.T_I64), counters

@@ -28,6 +28,18 @@ bit-packed ECMP next-hop set straight from the reverse distances —
 gathers over a per-node out-neighbor table, no scatters — so the entire
 fleet-wide route-building input is ONE device call returning
 [N, P] int32 distances + [N, P, W] uint32 next-hop bitmaps.
+
+The fast path goes further: the reverse in-edges of router v are
+exactly v's forward out-edges, so the ECMP condition
+``metric(v,u) + dist(u,p) == dist(v,p)`` is precisely "this reverse
+relax candidate is tight".  The fused program
+(_fused_progressive_banded) therefore computes the bitmap INSIDE the
+final verification pass of the banded kernel — each [N, P] gather is
+read once and feeds both the convergence verdict (min) and the bitmap
+(compare + OR into precomputed slot bits), replacing the round-5
+standalone bitmap pass that re-gathered the whole product.  The relax
+itself runs the progressive while-loop (ops.banded), so one dispatch
+covers relax + verify + bitmap and stops at the actual fixed point.
 """
 
 from __future__ import annotations
@@ -39,7 +51,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .sssp import INF16, INF32, clamp_metric_u16
+from .sssp import INF16, INF32, clamp_metric_u16, u16_saturation_verdict
 
 
 class OutEll(NamedTuple):
@@ -92,6 +104,202 @@ def build_out_ell(
         slot=jnp.asarray(slot),
         n_words=max(1, -(-max_slots // 32)),
     )
+
+
+class EpilogueMaps(NamedTuple):
+    """Reverse-slot -> forward-out-slot tables for the fused
+    verify+bitmap epilogue.  Reverse in-edges of v are exactly v's
+    forward out-edges: the reverse residual slot (v, k) with neighbor u
+    and the reverse band edge (v-c)%N -> v each correspond to one
+    forward out-edge of v, whose ECMP bit position is the rank of that
+    neighbor among v's sorted unique out-neighbors (OutEll.slot).
+    Host-built once per topology snapshot."""
+
+    resid_slot: jax.Array  # [N, K] int32 — forward out-slot; -1 pad
+    band_slot: jax.Array  # [B, N] int32 — forward out-slot; -1 no edge
+
+
+def build_epilogue_maps(bg, out: OutEll) -> EpilogueMaps:
+    """Map every reverse-graph relax slot (ops.banded.BandedGraph over
+    the REVERSED edges) to the forward out-slot bit it certifies.
+    Parallel forward links share a slot, and their reverse counterparts
+    occupy distinct residual slots (build_banded demotes band
+    duplicates), so every candidate lands on the right bit and the
+    min-metric parallel link is the one whose equality fires."""
+    nbr = np.asarray(out.nbr)
+    eid = np.asarray(out.eid)
+    slot = np.asarray(out.slot)
+    n = bg.n_nodes
+
+    def rank(u_row: np.ndarray, valid: np.ndarray) -> np.ndarray:
+        """Forward out-slot of edge v -> u_row[v]; -1 where invalid."""
+        m = (nbr[:n] == u_row[:, None]) & (eid[:n] >= 0)
+        s = np.where(m, slot[:n], -1).max(axis=1)
+        return np.where(valid, s, -1).astype(np.int32)
+
+    rn = np.asarray(bg.resid_nbr)
+    re_ = np.asarray(bg.resid_eid)
+    resid_slot = np.stack(
+        [rank(rn[:, k], re_[:, k] >= 0) for k in range(rn.shape[1])],
+        axis=1,
+    )
+    ids = np.arange(n, dtype=np.int64)
+    be = np.asarray(bg.band_eid)
+    band_slot = np.stack(
+        [
+            rank(((ids - c) % n).astype(np.int32), be[b] >= 0)
+            for b, c in enumerate(bg.offsets)
+        ]
+    )
+    return EpilogueMaps(
+        resid_slot=jnp.asarray(resid_slot), band_slot=jnp.asarray(band_slot)
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "check_every",
+        "max_blocks",
+        "depth",
+        "resid_rounds",
+        "small_dist",
+        "chord_mode",
+        "n_words",
+    ),
+)
+def _fused_progressive_banded(
+    dest_ids,
+    bg,
+    r_edge_up,  # REVERSED-graph runtime arrays (the runner's)
+    r_edge_metric,
+    node_overloaded,
+    resid_slot,  # EpilogueMaps
+    band_slot,
+    init_dist,  # [N*, P] warm-start upper bound or None
+    check_every: int,
+    max_blocks: int,
+    depth: int,
+    resid_rounds: int,
+    small_dist: bool,
+    chord_mode: bool,
+    n_words: int,
+):
+    """Relax + verify + ECMP bitmap as ONE compiled program, with the
+    bitmap folded into the verification pass: the progressive while-loop
+    (ops.banded) runs supersweep blocks to the fixed point, then a
+    single Jacobi epilogue re-evaluates every exact relax candidate ONCE
+    and uses it for BOTH the convergence verdict (min, v == d) and the
+    ECMP bit (cand == d, finite) — the [N, P] product is read once, not
+    re-gathered by a standalone bitmap pass.
+
+    Correctness of the bit rule: for the forward out-edge v->u the
+    reference condition metric(v,u) + dist(u,p) == dist(v,p)
+    (Decision.cpp:1296-1300) is exactly "the reverse candidate through u
+    is tight".  The candidate already encodes link-up and the drain
+    exception (overloaded u allowed only at d(u,p) == 0), and the
+    d < inf guard keeps unreachable rows bitless — a saturated cand can
+    alias the INF sentinel, so equality alone is not enough.  Bits are
+    meaningful only when ``converged`` is True (callers re-run
+    otherwise, exactly like the distances)."""
+    from .banded import _RelaxOps, make_dist0_orig
+
+    n = bg.n_nodes
+    d0 = make_dist0_orig(dest_ids, n, small_dist=small_dist)
+    if init_dist is not None:
+        init = init_dist[:n]
+        if small_dist and init.dtype != jnp.uint16:
+            init = jnp.minimum(init, INF16).astype(jnp.uint16)
+        elif not small_dist and init.dtype != jnp.int32:
+            init = jnp.where(
+                init >= INF16, jnp.int32(INF32), init.astype(jnp.int32)
+            )
+        # re-pin sources to 0; elsewhere keep the caller's bound
+        d0 = jnp.minimum(d0, init)
+    ops = _RelaxOps(
+        bg,
+        r_edge_up,
+        r_edge_metric,
+        node_overloaded[:n],
+        0 if chord_mode else depth,
+        resid_rounds,
+        None,
+        small_dist,
+        chord_mode,
+        d0.dtype,
+    )
+
+    def body(state):
+        d, _, i = state
+        for _ in range(check_every - 1):
+            d = ops.supersweep(d)
+        v = ops.supersweep(d)
+        return v, jnp.all(v == d), i + jnp.int32(1)
+
+    def cond(state):
+        _, conv, i = state
+        return jnp.logical_and(~conv, i < max_blocks)
+
+    d, _, blocks = jax.lax.while_loop(
+        cond, body, (d0, jnp.bool_(False), jnp.int32(0))
+    )
+
+    # fused verify+bitmap epilogue (authoritative exact check: the
+    # while-loop's own certificate is implied by v == d below)
+    p_dim = d.shape[1]
+    fin = d < ops.inf
+    v = d
+
+    def bit_of(slot_row):
+        return jnp.where(
+            slot_row >= 0,
+            jnp.uint32(1)
+            << (jnp.maximum(slot_row, 0) % 32).astype(jnp.uint32),
+            jnp.uint32(0),
+        )
+
+    # one (candidate, forward-slot-row) pair per reverse edge group;
+    # thunked so only one [N, P] candidate is live at a time
+    groups = [
+        (functools.partial(ops.resid_cand, d, k), resid_slot[:, k])
+        for k in range(ops.n_resid)
+    ] + [
+        (functools.partial(ops.band0_cand, d, b), band_slot[b])
+        for b in range(ops.n_bands)
+    ]
+    if n_words == 1:
+        bitmap2d = jnp.zeros((n, p_dim), dtype=jnp.uint32)
+        for mk_cand, srow in groups:
+            cand = mk_cand()
+            on = fin & (cand == d)
+            bitmap2d = bitmap2d | jnp.where(
+                on, bit_of(srow)[:, None], jnp.uint32(0)
+            )
+            v = jnp.minimum(v, cand)
+        bitmap = bitmap2d[:, :, None]
+    else:
+        bitmap = jnp.zeros((n, p_dim, n_words), dtype=jnp.uint32)
+        for mk_cand, srow in groups:
+            cand = mk_cand()
+            on = fin & (cand == d)
+            word_sel = (jnp.maximum(srow, 0) // 32)[:, None] == jnp.arange(
+                n_words
+            )[None, :]  # [N, W]
+            bitmap = bitmap | jnp.where(
+                on[:, :, None] & word_sel[:, None, :],
+                bit_of(srow)[:, None, None],
+                jnp.uint32(0),
+            )
+            v = jnp.minimum(v, cand)
+    converged = jnp.all(v == d)
+    if small_dist:
+        converged = u16_saturation_verdict(d, converged)
+    # blocks: executed while-loop blocks — blocks*check_every supersweeps
+    # ran, so that count is a PROVEN-sufficient fixed-sweep budget for
+    # this (topology, dest-set) shape; callers teach the runner's hint
+    # from it so fixed-sweep consumers (sharded product, masked variants)
+    # inherit the progressive run's auto-tuning
+    return d, bitmap, converged, blocks
 
 
 @functools.partial(jax.jit, static_argnames=("n_words",))
@@ -194,8 +402,11 @@ def reduced_all_sources(
     edge_up,
     node_overloaded,
     n_sweeps: Optional[int] = None,
-    fused: bool = False,
+    fused: Optional[bool] = None,
     init_dist=None,
+    maps: Optional[EpilogueMaps] = None,
+    check_every: int = 4,
+    max_blocks: int = 64,
 ):
     """Fleet-wide route-building input in one device round:
     (dist [N*, P] jax — dist[v, p] = dist(v -> p), nh_bitmap
@@ -215,32 +426,81 @@ def reduced_all_sources(
     exactly like SpfRunner.forward: a doubling overshoot would otherwise
     tax every later product round with up to 2x surplus supersweeps.
 
-    `fused` compiles the relax and the bitmap pass into ONE device
-    program (_fused_product), saving a dispatch fee.  It is OFF by
-    default on measurement: the round-5 tune clocked the fused program
-    ~100 ms SLOWER in-dispatch at wan100k/P=1024 (XLA schedules the
-    combined program worse) while the second dispatch of the unfused
-    path overlaps the relax and costs ~30 ms marginal — so fusion only
-    pays when the transport's flat per-dispatch fee is in its degraded
-    (~100-400 ms) window.
+    The DEFAULT path on banded topologies (`fused=None`) is the fused
+    PROGRESSIVE program (_fused_progressive_banded): relax, verify and
+    bitmap in one dispatch, the relax early-exiting on-device at the
+    actual fixed point (lax.while_loop over supersweep blocks of
+    `check_every`) and the bitmap folded into the verification pass so
+    the [N, P] product is read once.  This reverses the round-5 call:
+    that fusion merely concatenated the relax with a SECOND full bitmap
+    gather pass, which XLA scheduled worse than two pipelined
+    dispatches; with the bitmap riding the verification gathers there
+    is no second pass left to schedule, and the fixed-sweep hint (and
+    its overshoot) disappears entirely.  `fused=False` forces the
+    legacy two-dispatch path; `fused=True` with `n_sweeps` runs the
+    legacy fixed-sweep fused program (bench timing).
 
     `init_dist` ([N*, P], either distance dtype) warm-starts the relax
     from a caller-PROVEN elementwise upper bound — the previous product
-    of the same (node universe, dest set) after improvement-only
-    topology changes (see ops.banded.spf_forward_banded for the safety
-    argument and decision.fleet for the gate).  A converged warm round
-    equals the cold one exactly; callers pair it with a small adaptive
-    hint since few sweeps usually suffice.  Banded path only (the ELL
-    fallback cold-starts; the fused program ignores it too)."""
+    of the same (node universe, dest set) after gated topology changes
+    (see ops.banded.spf_forward_banded for the safety argument and
+    decision.fleet for both gate directions).  A converged warm round
+    equals the cold one exactly.  Banded path only (the ELL fallback
+    cold-starts).
+
+    `maps` (build_epilogue_maps) feeds the fused epilogue; built here
+    on first need when not supplied — callers that rebuild repeatedly
+    should build it once per topology snapshot."""
     import numpy as _np
 
-    if fused and init_dist is not None:
-        # the fused program has no dist0 input: attempts would run cold
-        # while probes run warm, and refine-down would record a hint no
-        # cold fused round can meet
-        raise ValueError("fused=True does not support init_dist")
+    if fused and n_sweeps is not None and init_dist is not None:
+        # the legacy fixed-sweep fused program has no dist0 input
+        raise ValueError("fused=True with n_sweeps does not support init_dist")
 
     dest_ids = jnp.asarray(_np.asarray(dest_ids, dtype=_np.int32))
+
+    if (
+        fused is not False
+        and n_sweeps is None
+        and reverse_runner.bg is not None
+    ):
+        # fast path: one progressive fused program, no sweep hint
+        if maps is None:
+            maps = build_epilogue_maps(reverse_runner.bg, out)
+        _, _, r_met, r_up, r_ov = reverse_runner.call_arrays()
+
+        def run_prog(small: bool):
+            return _fused_progressive_banded(
+                dest_ids,
+                reverse_runner.bg,
+                r_up,
+                r_met,
+                r_ov,
+                maps.resid_slot,
+                maps.band_slot,
+                init_dist,
+                check_every=check_every,
+                max_blocks=max_blocks,
+                depth=reverse_runner.depth,
+                resid_rounds=reverse_runner.resid_rounds,
+                small_dist=small,
+                chord_mode=reverse_runner.chord_mode,
+                n_words=out.n_words,
+            )
+
+        small = reverse_runner.small_dist
+        dist, bitmap, ok, blocks = run_prog(small)
+        if small and not bool(ok):
+            # saturation presents as non-convergence: latch uint16 off
+            # (the SpfRunner.adapt discipline) and retry once in int32
+            reverse_runner.small_allowed = False
+            dist, bitmap, ok, blocks = run_prog(False)
+        if bool(ok) and init_dist is None:
+            # teach the fixed-sweep hint from the cold progressive run
+            # (warm runs converge in delta-sized counts — not a valid
+            # cold budget, so they never write it)
+            reverse_runner.hint = max(1, int(blocks) * check_every)
+        return dist, bitmap, ok
 
     def run(sweeps: int, want_bitmap: bool):
         # the one-program fusion exists on the banded path only; the ELL

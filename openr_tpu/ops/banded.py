@@ -74,28 +74,44 @@ WBIG = jnp.int32(1 << 28)
 class BandedGraph:
     """Host-built circulant-band + residual-ELL decomposition.
 
-    Registered as a pytree with ``offsets``/``n_nodes`` as STATIC aux
-    data: band offsets drive roll shifts and loop structure, so they must
-    be Python ints under jit (a new band layout recompiles, matching the
-    shape-bucketed discipline of the ELL tables)."""
+    Registered as a pytree with ``offsets``/``n_nodes``/``resid_buckets``
+    as STATIC aux data: band offsets drive roll shifts and loop
+    structure, so they must be Python ints under jit (a new band layout
+    recompiles, matching the shape-bucketed discipline of the ELL
+    tables).  ``resid_buckets`` is a tuple of (lo, hi) residual-column
+    ranges grouped by chord-length scale: the chord-mode supersweep
+    fuses WITHIN a bucket (Jacobi) and chains ACROSS buckets
+    (Gauss-Seidel), so applying the short-chord bucket first lets the
+    long-chord bucket relax from already-updated distances — more
+    propagation per supersweep at identical gather cost.  A single
+    bucket reproduces the old all-Jacobi pass."""
 
-    def __init__(self, offsets, band_eid, resid_nbr, resid_eid, n_nodes):
+    def __init__(
+        self, offsets, band_eid, resid_nbr, resid_eid, n_nodes,
+        resid_buckets=None,
+    ):
         self.offsets = tuple(int(c) for c in offsets)
         self.band_eid = band_eid  # [B, N] int32 — edge of (v-c)%N -> v; -1
         self.resid_nbr = resid_nbr  # [N, K] int32 — residual in-nbrs (pad 0)
         self.resid_eid = resid_eid  # [N, K] int32 — residual edge ids; -1
         self.n_nodes = int(n_nodes)
+        if resid_buckets is None:
+            k = int(getattr(resid_nbr, "shape", (0, 1))[1])
+            resid_buckets = ((0, k),)
+        self.resid_buckets = tuple(
+            (int(lo), int(hi)) for lo, hi in resid_buckets
+        )
 
     def tree_flatten(self):
         return (
             (self.band_eid, self.resid_nbr, self.resid_eid),
-            (self.offsets, self.n_nodes),
+            (self.offsets, self.n_nodes, self.resid_buckets),
         )
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        offsets, n_nodes = aux
-        return cls(offsets, *children, n_nodes)
+        offsets, n_nodes, resid_buckets = aux
+        return cls(offsets, *children, n_nodes, resid_buckets)
 
 
 def build_banded(
@@ -160,6 +176,7 @@ def build_banded(
         return None
     resid_nbr = np.zeros((n_nodes, k_pad), dtype=np.int32)
     resid_eid = np.full((n_nodes, k_pad), -1, dtype=np.int32)
+    resid_buckets = ((0, k_pad),)
     if resid.size:
         order = np.argsort(dst[resid], kind="stable")
         r_sorted = resid[order]
@@ -168,12 +185,39 @@ def build_banded(
         slot = np.arange(r_sorted.size) - starts[d_sorted]
         resid_nbr[d_sorted, slot] = src[r_sorted].astype(np.int32)
         resid_eid[d_sorted, slot] = r_sorted.astype(np.int32)
+        # chord-bucketed residual order: sort each row's slots by folded
+        # chord length (short first) and split the columns into a
+        # short-chord and a long-chord bucket where the scales separate.
+        # The chord-mode supersweep chains the buckets Gauss-Seidel
+        # style, so long chords jump from distances the short chords
+        # already settled this sweep.
+        offs = (np.arange(n_nodes, dtype=np.int64)[:, None] - resid_nbr) % (
+            n_nodes
+        )
+        folded = np.minimum(offs, n_nodes - offs)
+        folded = np.where(resid_eid >= 0, folded, np.iinfo(np.int64).max)
+        col_order = np.argsort(folded, axis=1, kind="stable")
+        resid_nbr = np.take_along_axis(resid_nbr, col_order, axis=1)
+        resid_eid = np.take_along_axis(resid_eid, col_order, axis=1)
+        folded = np.take_along_axis(folded, col_order, axis=1)
+        # per-column median folded length over valid slots (columns hold
+        # row-wise order statistics, so medians are nondecreasing)
+        med = np.full(k_pad, np.inf)
+        for k in range(k_pad):
+            valid = resid_eid[:, k] >= 0
+            if valid.any():
+                med[k] = float(np.median(folded[valid, k]))
+        is_long = med > max(16.0, float(n_nodes) ** 0.5)
+        split = int(np.searchsorted(is_long, True))
+        if 0 < split < k_pad:
+            resid_buckets = ((0, split), (split, k_pad))
     return BandedGraph(
         offsets=tuple(offs_sorted),
         band_eid=jnp.asarray(band_eid),
         resid_nbr=jnp.asarray(resid_nbr),
         resid_eid=jnp.asarray(resid_eid),
         n_nodes=n_nodes,
+        resid_buckets=resid_buckets,
     )
 
 
@@ -237,6 +281,148 @@ def _resid_tables(bg, edge_up, edge_metric, node_overloaded, wbig):
     return w, ov
 
 
+class _RelaxOps:
+    """Shared relax/verify closures over one (graph, runtime-state)
+    binding — the single source of the relax semantics, consumed by the
+    fixed-sweep kernel, the progressive while-loop kernel, the fused
+    verify+bitmap epilogue (ops.allsources) and the warm-start
+    affected-set propagation (decision.fleet).  Built INSIDE a jit
+    trace; never passed across a jit boundary."""
+
+    def __init__(
+        self,
+        bg: BandedGraph,
+        edge_up,
+        edge_metric,
+        ov_n,  # [N] bool — node_overloaded already sliced to n_nodes
+        depth: int,
+        resid_rounds: int,
+        row_allowed_T,
+        small_dist: bool,
+        chord_mode: bool,
+        ddt,
+    ) -> None:
+        self.bg = bg
+        self.n = bg.n_nodes
+        self.chord_mode = chord_mode
+        self.resid_rounds = resid_rounds
+        self.ddt = ddt
+        self.inf = INF16 if small_dist else INF32
+        self.wbig = WBIG16 if small_dist else WBIG
+        self.n_resid = int(bg.resid_nbr.shape[1])
+        self.n_bands = len(bg.offsets)
+        self.band_tabs = _band_tables(
+            bg, edge_up, edge_metric, ov_n, depth, self.wbig
+        )
+        self.rw, self.rov = _resid_tables(
+            bg, edge_up, edge_metric, ov_n, self.wbig
+        )
+        # per-row exclusions: residual slot masks + band cut positions
+        if row_allowed_T is not None:
+            eid = bg.resid_eid
+            self.resid_excl = (eid >= 0)[:, :, None] & ~jnp.take(
+                row_allowed_T, jnp.maximum(eid, 0).reshape(-1), axis=0
+            ).reshape(eid.shape + (row_allowed_T.shape[1],))  # [N, K, S]
+            self.band_cut0 = []
+            for b in range(self.n_bands):
+                be = bg.band_eid[b]
+                cut = (be >= 0)[:, None] & ~jnp.take(
+                    row_allowed_T, jnp.maximum(be, 0), axis=0
+                )  # [N, S]
+                self.band_cut0.append(cut)
+        else:
+            self.resid_excl = None
+            self.band_cut0 = None
+
+    def resid_cand(self, d, k):
+        du = jnp.take(d, self.bg.resid_nbr[:, k], axis=0)  # [N, S]
+        allow = (self.rw[:, k] < self.wbig)[:, None] & (
+            ~self.rov[:, k][:, None] | (du == 0)
+        )
+        if self.resid_excl is not None:
+            allow &= ~self.resid_excl[:, k]
+        return jnp.where(
+            allow & (du < self.inf),
+            du + self.rw[:, k][:, None].astype(self.ddt),
+            self.inf,
+        )
+
+    def relax_resid(self, d):
+        for k in range(self.n_resid):
+            d = jnp.minimum(d, self.resid_cand(d, k))
+        return d
+
+    def band0_cand(self, d, b):
+        """Depth-0 band relax candidate with the exact source exception."""
+        c = self.bg.offsets[b]
+        w0, ov, _ = self.band_tabs[b]
+        du = jnp.roll(d, c, axis=0)
+        allow = (w0 < self.wbig) & (~ov | (du == 0))
+        if self.band_cut0 is not None:
+            allow = allow & ~self.band_cut0[b]
+        return jnp.where(
+            allow & (du < self.inf), du + w0.astype(self.ddt), self.inf
+        )
+
+    def relax_band0(self, d, b):
+        return jnp.minimum(d, self.band0_cand(d, b))
+
+    def relax_band_levels(self, d, b):
+        """Composed-shift relaxes (transit-blocked; no source exception)."""
+        c = self.bg.offsets[b]
+        _, _, levels = self.band_tabs[b]
+        cut = self.band_cut0[b] if self.band_cut0 is not None else None
+        for l, wl in enumerate(levels):
+            sh = (c << (l + 1)) % self.n
+            du = jnp.roll(d, sh, axis=0)
+            cand = jnp.where(
+                (wl < self.wbig) & (du < self.inf),
+                du + wl.astype(self.ddt),
+                self.inf,
+            )
+            if cut is not None:
+                # barrier: window of 2^(l+1) edges ending at v crosses a cut
+                cut = cut | jnp.roll(cut, (c << l) % self.n, axis=0)
+                cand = jnp.where(cut, self.inf, cand)
+            d = jnp.minimum(d, cand)
+        return d
+
+    def supersweep(self, d):
+        if self.chord_mode:
+            # fused Jacobi passes: residual gathers fused per chord-scale
+            # bucket (chained across buckets so long chords relax from
+            # the short chords' freshly settled distances), then all
+            # depth-0 band shifts in one min
+            for lo, hi in self.bg.resid_buckets:
+                cands = [self.resid_cand(d, k) for k in range(lo, hi)]
+                if cands:
+                    d = functools.reduce(jnp.minimum, [d] + cands)
+            return functools.reduce(
+                jnp.minimum,
+                [d] + [self.band0_cand(d, b) for b in range(self.n_bands)],
+            )
+        for _ in range(self.resid_rounds):
+            d = self.relax_resid(d)
+        for b in range(self.n_bands):
+            d = self.relax_band0(d, b)
+            d = self.relax_band_levels(d, b)
+        return d
+
+    def verify(self, d):
+        """One exact relax pass: v == d certifies the fixed point.
+        Depth-0 bands + residual cover every edge with exact drain
+        semantics.  The chord-mode supersweep is an equally exact CHECK:
+        its stages are monotone non-increasing, so an unchanged
+        composite means every stage — hence every single-edge candidate
+        — left d unchanged."""
+        if self.chord_mode:
+            return self.supersweep(d)
+        v = self.relax_resid(d)
+        for b in range(self.n_bands):
+            v = self.relax_band0(v, b)
+        return v
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -263,125 +449,187 @@ def batched_sssp_banded(
     """Fixed-supersweep banded relaxation.  Returns (dist [N, S] in
     ORIGINAL node order, converged bool).  See module docstring.
 
-    ``chord_mode`` swaps the sequential supersweep for the two-pass
+    ``chord_mode`` swaps the sequential supersweep for the bucketed
     Jacobi form measured fastest on chord-rich small-world graphs
-    (round-5 tune, wan100k P=1024): ONE fused min over all residual
-    gather candidates, then ONE fused min over all depth-0 band shifts.
-    Fewer, larger fusions cut the per-sweep HBM traffic ~30% and the
-    composed band levels (pure overhead when the supersweep count is
-    floored by chord-hop depth) are skipped; the chord-mode fixed point
-    needs a few more supersweeps (18 vs 14 at wan100k), which the
-    runner's adaptive hint learns.  Verification stays the sequential
-    exact relax, so the convergence verdict is unchanged."""
-    n = bg.n_nodes
-    inf = INF16 if small_dist else INF32
-    wbig = WBIG16 if small_dist else WBIG
-    ddt = dist0.dtype
-    ov_n = node_overloaded[:n]
-
-    band_tabs = _band_tables(
-        bg, edge_up, edge_metric, ov_n, 0 if chord_mode else depth, wbig
+    (round-5 tune, wan100k P=1024): fused mins over the residual gather
+    candidates (per chord-scale bucket), then ONE fused min over all
+    depth-0 band shifts.  Fewer, larger fusions cut the per-sweep HBM
+    traffic ~30% and the composed band levels (pure overhead when the
+    supersweep count is floored by chord-hop depth) are skipped; the
+    chord-mode fixed point needs a few more supersweeps, which the
+    runner's adaptive hint learns.  The verification relax stays an
+    exact check either way."""
+    ops = _RelaxOps(
+        bg,
+        edge_up,
+        edge_metric,
+        node_overloaded[: bg.n_nodes],
+        0 if chord_mode else depth,
+        resid_rounds,
+        row_allowed_T,
+        small_dist,
+        chord_mode,
+        dist0.dtype,
     )
-    rw, rov = _resid_tables(bg, edge_up, edge_metric, ov_n, wbig)
-
-    # per-row exclusions: residual slot masks + band cut positions
-    if row_allowed_T is not None:
-        eid = bg.resid_eid
-        resid_excl = (eid >= 0)[:, :, None] & ~jnp.take(
-            row_allowed_T, jnp.maximum(eid, 0).reshape(-1), axis=0
-        ).reshape(eid.shape + (row_allowed_T.shape[1],))  # [N, K, S]
-        band_cut0 = []
-        for b in range(len(bg.offsets)):
-            be = bg.band_eid[b]
-            cut = (be >= 0)[:, None] & ~jnp.take(
-                row_allowed_T, jnp.maximum(be, 0), axis=0
-            )  # [N, S]
-            band_cut0.append(cut)
-    else:
-        resid_excl = None
-        band_cut0 = None
-
-    def resid_cand(d, k):
-        du = jnp.take(d, bg.resid_nbr[:, k], axis=0)  # [N, S]
-        allow = (rw[:, k] < wbig)[:, None] & (
-            ~rov[:, k][:, None] | (du == 0)
-        )
-        if resid_excl is not None:
-            allow &= ~resid_excl[:, k]
-        return jnp.where(
-            allow & (du < inf), du + rw[:, k][:, None].astype(ddt), inf
-        )
-
-    def relax_resid(d):
-        for k in range(bg.resid_nbr.shape[1]):
-            d = jnp.minimum(d, resid_cand(d, k))
-        return d
-
-    def band0_cand(d, b):
-        """Depth-0 band relax candidate with the exact source exception."""
-        c = bg.offsets[b]
-        w0, ov, _ = band_tabs[b]
-        du = jnp.roll(d, c, axis=0)
-        allow = (w0 < wbig) & (~ov | (du == 0))
-        if band_cut0 is not None:
-            allow = allow & ~band_cut0[b]
-        return jnp.where(allow & (du < inf), du + w0.astype(ddt), inf)
-
-    def relax_band0(d, b):
-        return jnp.minimum(d, band0_cand(d, b))
-
-    def relax_band_levels(d, b):
-        """Composed-shift relaxes (transit-blocked; no source exception)."""
-        c = bg.offsets[b]
-        _, _, levels = band_tabs[b]
-        cut = band_cut0[b] if band_cut0 is not None else None
-        for l, wl in enumerate(levels):
-            sh = (c << (l + 1)) % n
-            du = jnp.roll(d, sh, axis=0)
-            cand = jnp.where(
-                (wl < wbig) & (du < inf), du + wl.astype(ddt), inf
-            )
-            if cut is not None:
-                # barrier: window of 2^(l+1) edges ending at v crosses a cut
-                cut = cut | jnp.roll(cut, (c << l) % n, axis=0)
-                cand = jnp.where(cut, inf, cand)
-            d = jnp.minimum(d, cand)
-        return d
-
-    def supersweep(d):
-        if chord_mode:
-            # two fused Jacobi passes: all residual gathers in one min,
-            # then all depth-0 band shifts in one min
-            d = functools.reduce(
-                jnp.minimum,
-                [d]
-                + [resid_cand(d, k) for k in range(bg.resid_nbr.shape[1])],
-            )
-            return functools.reduce(
-                jnp.minimum,
-                [d] + [band0_cand(d, b) for b in range(len(bg.offsets))],
-            )
-        for _ in range(resid_rounds):
-            d = relax_resid(d)
-        for b in range(len(bg.offsets)):
-            d = relax_band0(d, b)
-            d = relax_band_levels(d, b)
-        return d
-
-    d = jax.lax.fori_loop(0, n_supersweeps, lambda i, d: supersweep(d), dist0)
-
-    # verification: depth-0 bands + residual cover every edge with exact
-    # drain semantics, so v == d certifies the fixed point.  The Jacobi
-    # form (chord mode) is an equally exact CHECK: v == d iff no single
-    # edge improves on d, the same fixed-point condition the sequential
-    # pass tests — and it reuses the cheaper fused-pass structure.
-    if chord_mode:
-        v = supersweep(d)
-    else:
-        v = relax_resid(d)
-        for b in range(len(bg.offsets)):
-            v = relax_band0(v, b)
+    d = jax.lax.fori_loop(
+        0, n_supersweeps, lambda i, d: ops.supersweep(d), dist0
+    )
+    v = ops.verify(d)
     return v, jnp.all(v == d)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "check_every",
+        "max_blocks",
+        "depth",
+        "resid_rounds",
+        "small_dist",
+        "chord_mode",
+    ),
+)
+def batched_sssp_banded_progressive(
+    dist0: jax.Array,  # [N, S] — original node order
+    bg: BandedGraph,
+    edge_up: jax.Array,
+    edge_metric: jax.Array,
+    node_overloaded: jax.Array,
+    check_every: int = 4,
+    max_blocks: int = 64,
+    depth: int = 3,
+    resid_rounds: int = 1,
+    row_allowed_T: Optional[jax.Array] = None,
+    small_dist: bool = False,
+    chord_mode: bool = False,
+):
+    """Progressive on-device convergence: ``lax.while_loop`` over BLOCKS
+    of ``check_every`` supersweeps, early-exiting at the actual fixed
+    point instead of a host-learned sweep count.  The whole iteration
+    stays one compiled program with zero host syncs; the convergence
+    check (the block's last supersweep left d unchanged) costs one
+    [N, S] compare per block.
+
+    A run stops at the first block whose final supersweep is a no-op —
+    supersweep(d) == d certifies the fixed point because every stage is
+    monotone non-increasing (an unchanged composite means every exact
+    single-edge candidate left d unchanged; composed band levels only
+    ever relax along real paths, so they cannot undershoot).  Cold runs
+    therefore pay at most check_every-1 supersweeps past the fixed
+    point, not the adaptive hint's doubling overshoot; warm-started
+    runs (dist0 an upper bound, sources re-pinned by the caller) exit
+    after however few blocks the delta actually needs.  Returns
+    (dist [N, S], converged); converged is False only when max_blocks
+    ran out (or, for uint16 runs, when the caller's saturation guard
+    trips afterwards)."""
+    ops = _RelaxOps(
+        bg,
+        edge_up,
+        edge_metric,
+        node_overloaded[: bg.n_nodes],
+        0 if chord_mode else depth,
+        resid_rounds,
+        row_allowed_T,
+        small_dist,
+        chord_mode,
+        dist0.dtype,
+    )
+
+    def body(state):
+        d, _, i = state
+        for _ in range(check_every - 1):
+            d = ops.supersweep(d)
+        v = ops.supersweep(d)
+        return v, jnp.all(v == d), i + jnp.int32(1)
+
+    def cond(state):
+        _, conv, i = state
+        return jnp.logical_and(~conv, i < max_blocks)
+
+    d, conv, _ = jax.lax.while_loop(
+        cond, body, (dist0, jnp.bool_(False), jnp.int32(0))
+    )
+    return d, conv
+
+
+@functools.partial(
+    jax.jit, static_argnames=("small_dist", "max_iters")
+)
+def affected_mask(
+    dist: jax.Array,  # [N*, S] — previous CONVERGED reverse distances
+    bg: BandedGraph,  # previous topology's banded decomposition
+    edge_up: jax.Array,  # previous runtime arrays (the OLD graph)
+    edge_metric: jax.Array,
+    node_overloaded: jax.Array,
+    worsened_resid: jax.Array,  # [N, K] bool — resid slot's edge worsened
+    worsened_band: jax.Array,  # [B, N] bool — band position's edge worsened
+    small_dist: bool = False,
+    max_iters: int = 128,
+):
+    """Worsening-direction warm-start support: the entries of the OLD
+    fixed point that a set of worsened edges (removed / metric-increased
+    / newly-drained transit) can possibly have invalidated.
+
+    aff[v, s] is set iff some OLD tight chain into v (a chain of relax
+    candidates achieving equality, i.e. a shortest-path-DAG path)
+    crosses a worsened edge — propagated by OR along tight edges with a
+    ``lax.while_loop`` to a CERTIFIED fixpoint (a full pass with no
+    change).  The ANY-rule is a conservative superset of the exact
+    "all shortest paths broken" set: re-initializing a superset to INF
+    only costs extra re-relax work, never correctness, because the old
+    value stays a valid upper bound wherever ANY surviving old shortest
+    path avoids the worsened set.  Returns (aff [N, S] bool, done);
+    done=False means max_iters ran out BEFORE the fixpoint and the
+    caller MUST cold-start (an under-propagated set is silently wrong).
+
+    Cost: one pass ≈ one depth-0 supersweep plus bool-matrix gathers —
+    propagation needs only the exact depth-0 stages, so composed band
+    levels are skipped (long straight band runs take one hop per pass;
+    chord-rich graphs, where warm starts matter most, need few passes).
+    """
+    n = bg.n_nodes
+    ops = _RelaxOps(
+        bg,
+        edge_up,
+        edge_metric,
+        node_overloaded[:n],
+        0,
+        1,
+        None,
+        small_dist,
+        False,
+        dist.dtype,
+    )
+    d = dist[:n]
+    fin = d < ops.inf
+
+    def sweep(aff):
+        for k in range(ops.n_resid):
+            tight = fin & (ops.resid_cand(d, k) == d)
+            seed = worsened_resid[:, k][:, None] | jnp.take(
+                aff, bg.resid_nbr[:, k], axis=0
+            )
+            aff = aff | (tight & seed)
+        for b, c in enumerate(bg.offsets):
+            tight = fin & (ops.band0_cand(d, b) == d)
+            seed = worsened_band[b][:, None] | jnp.roll(aff, c, axis=0)
+            aff = aff | (tight & seed)
+        return aff
+
+    def body(state):
+        aff, _, i = state
+        new = sweep(aff)
+        return new, jnp.all(new == aff), i + jnp.int32(1)
+
+    def cond(state):
+        _, done, i = state
+        return jnp.logical_and(~done, i < max_iters)
+
+    aff0 = jnp.zeros(d.shape, dtype=jnp.bool_)
+    aff, done, _ = jax.lax.while_loop(
+        cond, body, (aff0, jnp.bool_(False), jnp.int32(0))
+    )
+    return aff, done
 
 
 @functools.partial(
@@ -396,6 +644,9 @@ def batched_sssp_banded(
         "chord_mode",
         "raw_u16",
         "transpose",
+        "progressive",
+        "check_every",
+        "max_blocks",
     ),
 )
 def spf_forward_banded(
@@ -417,11 +668,21 @@ def spf_forward_banded(
     raw_u16: bool = False,
     transpose: bool = True,
     dist0: Optional[jax.Array] = None,  # [N, S] warm-start upper bound
+    progressive: bool = False,
+    check_every: int = 4,
+    max_blocks: int = 64,
 ):
     """Banded forward pass: distances (+ optional SP-DAG) + convergence
     verdict.  Output contract matches ops.sssp.spf_forward_ell — dist
     [S, N] int32 (INF32 unreachable), dag [S, E_cap] — so callers can
     swap kernels by topology shape.
+
+    ``progressive`` replaces the fixed ``n_supersweeps``-then-verify
+    discipline with the on-device early-exit iteration
+    (batched_sssp_banded_progressive): the run stops at the actual
+    fixed point, ``n_supersweeps`` is ignored, and ``converged`` is
+    False only when check_every*max_blocks supersweeps ran out (or the
+    uint16 saturation guard trips).
 
     ``dist0`` warm-starts the relax from a caller-supplied ELEMENTWISE
     UPPER BOUND on the true distances ([N, S], either dtype — converted
@@ -486,19 +747,35 @@ def spf_forward_banded(
             )
         # re-pin sources to 0; elsewhere keep the caller's bound
         d0 = jnp.minimum(d0, init)
-    dist, converged = batched_sssp_banded(
-        d0,
-        bg,
-        edge_up,
-        metric,
-        node_overloaded,
-        n_supersweeps,
-        depth=depth,
-        resid_rounds=resid_rounds,
-        row_allowed_T=row_allowed_T,
-        small_dist=small_dist,
-        chord_mode=chord_mode,
-    )
+    if progressive:
+        dist, converged = batched_sssp_banded_progressive(
+            d0,
+            bg,
+            edge_up,
+            metric,
+            node_overloaded,
+            check_every=check_every,
+            max_blocks=max_blocks,
+            depth=depth,
+            resid_rounds=resid_rounds,
+            row_allowed_T=row_allowed_T,
+            small_dist=small_dist,
+            chord_mode=chord_mode,
+        )
+    else:
+        dist, converged = batched_sssp_banded(
+            d0,
+            bg,
+            edge_up,
+            metric,
+            node_overloaded,
+            n_supersweeps,
+            depth=depth,
+            resid_rounds=resid_rounds,
+            row_allowed_T=row_allowed_T,
+            small_dist=small_dist,
+            chord_mode=chord_mode,
+        )
     dist16 = None
     if small_dist:
         # callers must already exclude metrics >= WBIG16 — those edges
@@ -579,7 +856,32 @@ class SpfRunner:
                     (np.asarray(bg.resid_eid) >= 0).sum()
                 ) / float(n_edges)
                 self.chord_mode = resid_frac > 0.25
-                depth = 0 if self.chord_mode else 2
+                if self.chord_mode:
+                    depth = 0
+                else:
+                    # band-dominated graphs: auto-tune the composed-shift
+                    # depth to the longest straight band run (~sqrt(N)
+                    # on grid-like topologies — a row/column of the
+                    # grid), so a run settles in one supersweep's
+                    # O(log run) composed relaxes instead of paying one
+                    # hop per supersweep.  Capped at 6: each level is an
+                    # extra [N, S] pass per band per supersweep, and
+                    # past 2^7-hop windows the supersweep count is
+                    # floored by inter-band turns anyway.
+                    depth = max(
+                        2,
+                        min(
+                            6,
+                            int(
+                                np.ceil(
+                                    np.log2(
+                                        max(4.0, float(bg.n_nodes) ** 0.5)
+                                    )
+                                )
+                            )
+                            - 1,
+                        ),
+                    )
             else:
                 depth = 2
         self.depth = depth
@@ -756,6 +1058,7 @@ class SpfRunner:
         raw_u16: bool = False,
         transpose: bool = True,
         dist0=None,
+        progressive: bool = False,
     ):
         """One fixed-sweep device call; returns jax (dist, dag, ok).
         With ``raw_u16`` a uint16 banded run returns raw uint16
@@ -763,7 +1066,9 @@ class SpfRunner:
         ``transpose=False`` (want_dag=False only) keeps the kernel's
         native [N, S] layout.  ``dist0`` warm-starts the banded kernel
         from a caller-proven upper bound (see spf_forward_banded; the
-        ELL fallback ignores it — cold start, still exact)."""
+        ELL fallback ignores it — cold start, still exact).
+        ``progressive`` (banded only) runs the early-exit while-loop
+        iteration; ``n_sweeps`` is then ignored."""
         from .sssp import spf_forward_ell_sweeps
 
         edge_src, edge_dst, edge_metric, edge_up, node_overloaded = (
@@ -801,6 +1106,7 @@ class SpfRunner:
                 raw_u16=raw_u16,
                 transpose=transpose,
                 dist0=dist0,
+                progressive=progressive,
             )
         return spf_forward_ell_sweeps(
             sources,

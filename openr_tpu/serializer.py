@@ -57,6 +57,14 @@ def _from_jsonable(cls: Any, data: Any) -> Any:
         return None
     if isinstance(data, dict) and "__bytes__" in data:
         return bytes.fromhex(data["__bytes__"])
+    # an annotation like dict[str, "X"] keeps the INNER forward reference
+    # as a plain string even through typing.get_type_hints (the outer
+    # eval treats it as a str literal): resolve by registry name or the
+    # value silently stays a dict
+    if isinstance(cls, str):
+        cls = _TYPE_REGISTRY.get(cls, Any)
+    elif isinstance(cls, typing.ForwardRef):
+        cls = _TYPE_REGISTRY.get(cls.__forward_arg__, Any)
     # typing.get_origin/get_args normalize both typing.Optional/Union and
     # PEP-604 `X | None` unions (which carry no __origin__ themselves)
     origin = typing.get_origin(cls)

@@ -256,7 +256,11 @@ class SparkHelloMsg:
     node_name: str
     if_name: str
     seq_num: int
-    neighbor_infos: dict[str, "ReflectedNeighborInfo"] = field(default_factory=dict)
+    # NOTE: no quotes around the value type — `from __future__ import
+    # annotations` already defers evaluation, and an INNER string literal
+    # would survive typing.get_type_hints as a plain str, which the wire
+    # deserializer cannot resolve to the dataclass
+    neighbor_infos: dict[str, ReflectedNeighborInfo] = field(default_factory=dict)
     version: int = 1
     solicit_response: bool = False
     restarting: bool = False

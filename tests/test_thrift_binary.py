@@ -657,6 +657,33 @@ class TestShimRouteExchange:
         )
         assert {r.dest for r in routes} <= {r.dest for r in all_routes}
 
+    def test_get_unicast_routes_filtered_longest_prefix(self, pair):
+        # Fib.cpp:268 semantics, not exact dict-key lookup: the filter
+        # entries are NORMALIZED (non-canonical spellings hit), host
+        # addresses return their COVERING route by longest-prefix
+        # match, malformed entries match nothing, duplicates collapse
+        port = pair[0].thrift_shim.port
+        spec = tb.StructSpec(
+            "prefixes_args",
+            None,
+            (tb.Field(1, "prefixes", ("list", tb.T_STRING)),),
+        )
+        queries = [
+            "fc01:0:0:0::/64",  # non-canonical spelling of fc01::/64
+            "fc01::1/128",  # host address inside the advertised /64
+            "not-a-prefix",  # malformed: skipped, not an error
+            "fc01::/64",  # duplicate of the first (normalized)
+            "fc02::/64",  # no covering route
+        ]
+        routes = _call_ok(
+            port,
+            "getUnicastRoutesFiltered",
+            21,
+            tb.encode_struct(spec, {"prefixes": queries}),
+            ("list", ("struct", tb.UNICAST_ROUTE)),
+        )
+        assert [r.dest for r in routes] == ["fc01::/64"]
+
     def test_get_counters_over_the_wire(self, pair):
         port = pair[0].thrift_shim.port
         counters = _call_ok(
@@ -689,6 +716,41 @@ class TestShimRouteExchange:
             k.startswith("decision.") for k in filtered
         )
         assert set(filtered) <= set(counters)
+
+    def test_get_regex_counters_bounded(self, pair):
+        # pathological client patterns must answer as thrift application
+        # exceptions (shim.MAX_COUNTER_REGEX_LEN cap + guarded compile),
+        # never pin or kill the shim event loop — and the connection
+        # stays serviceable afterwards
+        from openr_tpu.interop.shim import MAX_COUNTER_REGEX_LEN
+
+        port = pair[0].thrift_shim.port
+        spec = tb.StructSpec(
+            "regex_args", None, (tb.Field(1, "regex", tb.T_STRING),)
+        )
+
+        def call(regex, seqid):
+            return _thrift_call(
+                port,
+                "getRegexCounters",
+                seqid,
+                tb.encode_struct(spec, {"regex": regex}),
+            )
+
+        _, mtype, _, _ = call("(" * 50, 22)  # unbalanced: re.error
+        assert mtype == tb.MSG_EXCEPTION
+        _, mtype, _, _ = call("a" * (MAX_COUNTER_REGEX_LEN + 1), 23)
+        assert mtype == tb.MSG_EXCEPTION
+        # a sane pattern still answers on the same shim
+        filtered = _call_ok(
+            port,
+            "getRegexCounters",
+            24,
+            tb.encode_struct(spec, {"regex": "^decision\\."}),
+            ("map", tb.T_STRING, tb.T_I64),
+            dec=lambda m: {k.decode(): v for k, v in m.items()},
+        )
+        assert filtered
 
     def test_get_mpls_routes_matches_fib(self, pair):
         port = pair[0].thrift_shim.port
