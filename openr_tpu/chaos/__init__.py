@@ -1,0 +1,29 @@
+"""Seeded, deterministic fault injection for multi-node tests.
+
+One seed drives every fault decision (packet drop/duplicate/reorder/
+delay, transport partitions, Fib agent crashes, KvStore sync failures),
+so any chaos run replays bit-for-bit from its seed — the DeltaPath-style
+churn-correctness proof machinery for this repo (see PAPERS.md).
+"""
+
+from .chaos import (
+    ChaosEventLog,
+    ChaosIoProvider,
+    ChaosSpfBackend,
+    FibChaosPlan,
+    KvChaosInjector,
+    LinkFaultProfile,
+)
+from .scenario import ChaosScenario, fib_unicast_routes, oracle_route_dbs
+
+__all__ = [
+    "ChaosEventLog",
+    "ChaosIoProvider",
+    "ChaosScenario",
+    "ChaosSpfBackend",
+    "FibChaosPlan",
+    "KvChaosInjector",
+    "LinkFaultProfile",
+    "fib_unicast_routes",
+    "oracle_route_dbs",
+]

@@ -1,0 +1,97 @@
+"""Scripted multi-node chaos scenarios over the in-process daemon fabric.
+
+A ChaosScenario records every scripted action (and every wait outcome)
+into the shared ChaosEventLog's "scenario" stream, so two runs of the
+same timeline from the same seed can be compared with
+ChaosEventLog.matches().  Convergence is judged bit-exactly against a
+host-oracle recompute of each daemon's routes (oracle_route_dbs) rather
+than against another daemon — the oracle cannot itself be perturbed by
+the chaos under test.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..decision.spf_solver import HostSpfBackend, SpfSolver
+from .chaos import SCENARIO_STREAM, ChaosEventLog, wait_until
+
+FIB_CLIENT = 786
+
+
+def fib_unicast_routes(daemon) -> dict[str, frozenset]:
+    """The daemon's programmed unicast FIB as {dest: next-hop set}."""
+    table = daemon.fib_agent.unicast.get(FIB_CLIENT, {})
+    return {dest: frozenset(route.next_hops) for dest, route in table.items()}
+
+
+def oracle_route_dbs(daemon) -> dict[str, frozenset]:
+    """Host-oracle recompute of the daemon's own routes.
+
+    Builds a fresh SpfSolver pinned to HostSpfBackend over the daemon's
+    current link/prefix state (read inside the decision thread, so no
+    torn state) and returns {dest: next-hop set} for installable routes.
+    Static routes are not replicated — scenarios compare dynamic state.
+    """
+    decision = daemon.decision
+
+    def _compute() -> dict[str, frozenset]:
+        solver = SpfSolver(
+            decision.my_node_name,
+            enable_v4=decision.spf_solver.enable_v4,
+            bgp_dry_run=decision.spf_solver.bgp_dry_run,
+            enable_best_route_selection=(
+                decision.spf_solver.enable_best_route_selection
+            ),
+            spf_backend=HostSpfBackend(),
+        )
+        db = solver.build_route_db(decision.area_link_states, decision.prefix_state)
+        if db is None:
+            return {}
+        return {
+            prefix: frozenset(entry.nexthops)
+            for prefix, entry in db.unicast_routes.items()
+            if not entry.do_not_install
+        }
+
+    return decision.run_in_event_base_thread(_compute).result()
+
+
+def fib_matches_oracle(daemon) -> bool:
+    return fib_unicast_routes(daemon) == oracle_route_dbs(daemon)
+
+
+class ChaosScenario:
+    """A replayable fault timeline: named steps plus logged waits."""
+
+    def __init__(self, log_: Optional[ChaosEventLog] = None) -> None:
+        self.log = log_ if log_ is not None else ChaosEventLog()
+
+    def step(self, name: str, fn: Optional[Callable[[], object]] = None):
+        """Log a scripted action, then perform it."""
+        self.log.append(SCENARIO_STREAM, name)
+        return fn() if fn is not None else None
+
+    def wait(
+        self,
+        name: str,
+        cond: Callable[[], bool],
+        timeout_s: float = 20.0,
+    ) -> bool:
+        """Wait on a condition; the outcome is part of the replay log."""
+        ok = wait_until(cond, timeout_s)
+        self.log.append(SCENARIO_STREAM, f"{name}:{'ok' if ok else 'timeout'}")
+        return ok
+
+    def wait_converged(self, daemons, timeout_s: float = 30.0) -> bool:
+        """Wait until every daemon's FIB bit-exactly matches its own
+        host-oracle recompute (stable across two consecutive polls, so a
+        rebuild in flight between the FIB read and the oracle read does
+        not produce a false positive)."""
+
+        def _all_match() -> bool:
+            return all(fib_matches_oracle(d) for d in daemons) and all(
+                fib_matches_oracle(d) for d in daemons
+            )
+
+        return self.wait("converged", _all_match, timeout_s)

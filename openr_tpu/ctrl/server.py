@@ -53,9 +53,13 @@ class OpenrCtrlHandler:
         kvstore_updates_queue: Optional[ReplicateQueue[Publication]] = None,
         fib_updates_queue: Optional[ReplicateQueue] = None,
         config_store=None,
+        watchdog=None,
+        queues: Optional[dict[str, ReplicateQueue]] = None,
     ) -> None:
         self.node_name = node_name
         self.config_store = config_store
+        self.watchdog = watchdog
+        self.queues = queues
         self.kvstore = kvstore
         self.decision = decision
         self.fib = fib
@@ -286,6 +290,12 @@ class OpenrCtrlHandler:
                 out.update(get())
             elif hasattr(module, "counters"):
                 out.update(module.counters)
+        if self.watchdog is not None:
+            out.update(self.watchdog.get_counters())
+        if self.queues:
+            from ..runtime.queue import queue_counters
+
+            out.update(queue_counters(self.queues))
         return out
 
     def _kvstore_dump_filtered(self, p: dict) -> Any:

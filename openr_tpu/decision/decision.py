@@ -35,7 +35,7 @@ from .link_state import LinkState, LinkStateChange
 from .prefix_state import PrefixState
 from .rib import DecisionRouteDb, DecisionRouteUpdate
 from .rib_policy import PolicyError, RibPolicy, RibPolicyConfig
-from .spf_solver import SpfBackend, SpfSolver
+from .spf_solver import HostSpfBackend, SpfBackend, SpfSolver
 
 FIB_TIME_MARKER = "fibTime:"
 
@@ -349,6 +349,29 @@ class Decision(OpenrEventBase):
             return
         self.pending_updates.add_event(event)
 
+        try:
+            update = self._compute_route_update()
+        except Exception:
+            # degradation ladder bottom rung: the solver's own device->
+            # host fallbacks should make this unreachable, but a rebuild
+            # failure must NEVER drop the route publication — demote the
+            # solver to the host oracle permanently and recompute full
+            log.exception(
+                "decision: route rebuild failed; recomputing on host oracle"
+            )
+            self.spf_solver._bump("decision.device_fallbacks")
+            self._bump("decision.route_rebuild_fallbacks")
+            self.spf_solver.spf = HostSpfBackend()
+            self.pending_updates.set_needs_full_rebuild()
+            update = self._compute_route_update()
+
+        self.route_db.update(update)
+        self.pending_updates.add_event("ROUTE_UPDATE")
+        update.perf_events = self.pending_updates.move_out_events()
+        self.pending_updates.reset()
+        self._route_updates_queue.push(update)
+
+    def _compute_route_update(self) -> DecisionRouteUpdate:
         update = DecisionRouteUpdate()
         if self.pending_updates.needs_full_rebuild:
             maybe_db = self.spf_solver.build_route_db(
@@ -372,12 +395,7 @@ class Decision(OpenrEventBase):
                     update.unicast_routes_to_update
                 )
                 update.unicast_routes_to_delete.extend(changes.deleted_routes)
-
-        self.route_db.update(update)
-        self.pending_updates.add_event("ROUTE_UPDATE")
-        update.perf_events = self.pending_updates.move_out_events()
-        self.pending_updates.reset()
-        self._route_updates_queue.push(update)
+        return update
 
     # -- ordered-FIB holds ---------------------------------------------------
 

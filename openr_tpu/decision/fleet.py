@@ -265,6 +265,7 @@ class FleetRouteView:
         self._out = None  # ops.allsources.OutEll
         self._rows: dict[int, np.ndarray] = {}  # node id -> [P] int32
         self.converged = False
+        self.cold_fallback = False  # warm gate failed; cache retried cold
         self.warm = False  # computed from a previous view's distances
         # None | "improve" | "worsen" — which warm gate admitted the seed
         self.warm_mode: Optional[str] = None
@@ -540,12 +541,23 @@ class FleetViewCache:
         # cold seed always flows in; the warm seed applies only if the
         # warm path engages (compute() decides — ELL fallbacks stay
         # cold), and harvesting routes by what actually ran
-        view.compute(
-            hint_seed=self._hints.get(key),
-            init_from=init_from,
-            warm_seed=self._warm_hints.get(key, 4),
-            down_from=down_from,
-        )
+        try:
+            view.compute(
+                hint_seed=self._hints.get(key),
+                init_from=init_from,
+                warm_seed=self._warm_hints.get(key, 4),
+                down_from=down_from,
+            )
+        except Exception:
+            if init_from is None and down_from is None:
+                raise  # cold run failed: nothing softer to retry with
+            # warm-start gate failure (bad seed, uncertifiable affected
+            # set, device error during the seeded relax): retry COLD on a
+            # fresh view — the caller reads cold_fallback for counters
+            log.warning("fleet: warm-started rebuild failed; retrying cold")
+            view = FleetRouteView(csr, dest_names)
+            view.compute(hint_seed=self._hints.get(key))
+            view.cold_fallback = True
         if view.sweep_hint is not None:
             store = self._warm_hints if view.warm else self._hints
             # max-merge, like DeviceSpfBackend._harvest_hint

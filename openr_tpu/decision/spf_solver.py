@@ -457,6 +457,10 @@ class SpfSolver:
         self.bgp_dry_run = bgp_dry_run
         self.enable_best_route_selection = enable_best_route_selection
         self.spf = spf_backend or HostSpfBackend()
+        # degradation ladder rung 1: any device-backend dispatch failure
+        # is served from this host oracle instead (memoized Dijkstra) —
+        # route correctness is never hostage to the accelerator
+        self._host_fallback: Optional[HostSpfBackend] = None
         # fleet-product views (reduced all-sources reverse-SSSP consumer;
         # active per build via build_route_db(fleet_views=...))
         self.fleet = FleetViewCache()
@@ -470,6 +474,32 @@ class SpfSolver:
 
     def _bump(self, counter: str, n: int = 1) -> None:
         self.counters[counter] = self.counters.get(counter, 0) + n
+
+    # -- degradation ladder (device -> host oracle) --------------------------
+
+    def _host_oracle(self, why: str) -> HostSpfBackend:
+        """Account a device fallback and return the host oracle backend."""
+        if self._host_fallback is None:
+            self._host_fallback = HostSpfBackend()
+        self._bump("decision.device_fallbacks")
+        log.warning("decision: device SPF failed (%s); using host oracle", why)
+        return self._host_fallback
+
+    def _spf_result(self, link_state: LinkState, src: str):
+        try:
+            return self.spf.get_spf_result(link_state, src)
+        except Exception:
+            return self._host_oracle("get_spf_result").get_spf_result(
+                link_state, src
+            )
+
+    def _kth_paths(self, link_state: LinkState, src: str, dest: str, k: int):
+        try:
+            return self.spf.get_kth_paths(link_state, src, dest, k)
+        except Exception:
+            return self._host_oracle("get_kth_paths").get_kth_paths(
+                link_state, src, dest, k
+            )
 
     # -- static route overlays ----------------------------------------------
 
@@ -539,19 +569,29 @@ class SpfSolver:
                 for (node, parea) in prefix_entries
                 if parea == area and view.covers(node)
             ):
-                # fleet product answers reachability without a per-source
-                # SPF: dist(me -> advertiser) < INF (module doc, fleet.py)
-                prefix_entries = {
-                    (node, parea): entry
-                    for (node, parea), entry in prefix_entries.items()
-                    if area != parea
-                    or (
-                        view.covers(node)
-                        and view.reachable(self.my_node_name, node)
+                try:
+                    # fleet product answers reachability without a per-
+                    # source SPF: dist(me -> advertiser) < INF (fleet.py)
+                    prefix_entries = {
+                        (node, parea): entry
+                        for (node, parea), entry in prefix_entries.items()
+                        if area != parea
+                        or (
+                            view.covers(node)
+                            and view.reachable(self.my_node_name, node)
+                        )
+                    }
+                    continue
+                except Exception:
+                    # device row fetch died mid-query: fall through to the
+                    # per-source path (itself host-oracle-backed)
+                    self._bump("decision.device_fallbacks")
+                    log.warning(
+                        "decision: fleet view query failed for area %s; "
+                        "per-source fallback",
+                        area,
                     )
-                }
-                continue
-            my_spf = self.spf.get_spf_result(link_state, self.my_node_name)
+            my_spf = self._spf_result(link_state, self.my_node_name)
             prefix_entries = {
                 (node, parea): entry
                 for (node, parea), entry in prefix_entries.items()
@@ -833,16 +873,21 @@ class SpfSolver:
             # masked kernel run instead of per-destination host recursion)
             prefetch = getattr(self.spf, "prefetch_kth_paths", None)
             if prefetch is not None:
-                prefetch(
-                    link_state,
-                    self.my_node_name,
-                    sorted({node for node, _ in best.all_node_areas}),
-                )
+                try:
+                    prefetch(
+                        link_state,
+                        self.my_node_name,
+                        sorted({node for node, _ in best.all_node_areas}),
+                    )
+                except Exception:
+                    # prefetch is an optimization: per-path queries below
+                    # fall back to the host oracle individually
+                    self._bump("decision.device_fallbacks")
             # shortest paths first
             for node, best_area in sorted(best.all_node_areas):
                 if node == self.my_node_name and best_area == area:
                     continue
-                for path in self.spf.get_kth_paths(
+                for path in self._kth_paths(
                     link_state, self.my_node_name, node, 1
                 ):
                     paths.append((area, path))
@@ -852,7 +897,7 @@ class SpfSolver:
             for node, best_area in sorted(best.all_node_areas):
                 if area != best_area:
                     continue
-                for sec_path in self.spf.get_kth_paths(
+                for sec_path in self._kth_paths(
                     link_state, self.my_node_name, node, 2
                 ):
                     from .link_state import path_a_in_path_b
@@ -989,16 +1034,24 @@ class SpfSolver:
         for area, link_state in area_link_states.items():
             view = self._fleet_views.get(area)
             if view is not None and self._fleet_usable(view, dst_node_areas):
-                shortest = self._fleet_next_hops_with_metric(
-                    view,
-                    link_state,
-                    dst_node_areas,
-                    per_destination,
-                    shortest,
-                    nexthop_nodes,
-                )
-                continue
-            spf = self.spf.get_spf_result(link_state, self.my_node_name)
+                try:
+                    shortest = self._fleet_next_hops_with_metric(
+                        view,
+                        link_state,
+                        dst_node_areas,
+                        per_destination,
+                        shortest,
+                        nexthop_nodes,
+                    )
+                    continue
+                except Exception:
+                    self._bump("decision.device_fallbacks")
+                    log.warning(
+                        "decision: fleet next-hop query failed for area %s; "
+                        "per-source fallback",
+                        area,
+                    )
+            spf = self._spf_result(link_state, self.my_node_name)
             min_metric, min_cost_nodes = self._get_min_cost_nodes(
                 spf, dst_node_areas
             )
@@ -1234,7 +1287,10 @@ class SpfSolver:
                             ksp2_dests.add(node)
                 if ksp2_dests:
                     for link_state in area_link_states.values():
-                        prefetch(link_state, me, sorted(ksp2_dests))
+                        try:
+                            prefetch(link_state, me, sorted(ksp2_dests))
+                        except Exception:
+                            self._bump("decision.device_fallbacks")
 
             for prefix in prefix_state.prefixes:
                 route = self.create_route_for_prefix(
@@ -1288,9 +1344,22 @@ class SpfSolver:
                 if min_sources is not None and len(dests) < min_sources:
                     continue
             cached = self.fleet.is_warm(ls, dests)
-            view = self.fleet.view(
-                ls, dests, csr=mirror(ls) if mirror is not None else None
-            )
+            try:
+                view = self.fleet.view(
+                    ls, dests, csr=mirror(ls) if mirror is not None else None
+                )
+            except Exception:
+                # fleet-product dispatch failed outright (mirror build or
+                # both cold attempts): serve this area per-source off the
+                # host oracle instead of dropping the rebuild
+                self._bump("decision.device_fallbacks")
+                self._bump("decision.fleet_view_failures")
+                log.warning(
+                    "decision: fleet product failed for area %s; "
+                    "serving per-source from host oracle",
+                    area,
+                )
+                continue
             if view is not None:
                 views[area] = view
                 if not cached:
@@ -1305,6 +1374,10 @@ class SpfSolver:
                     )
                     if view.warm_mode == "worsen":
                         self._bump("decision.fleet_rebuild_warm_down")
+                    if getattr(view, "cold_fallback", False):
+                        # warm-start gate blew up and the cache retried
+                        # cold (ladder rung 2, FleetViewCache.view)
+                        self._bump("decision.fleet_warm_fallbacks")
         return views
 
     def any_node_route_db(
@@ -1330,7 +1403,10 @@ class SpfSolver:
             wanted = {node}
             for link in ls.links_from_node(node):
                 wanted.add(link.other_node_name(node))
-            view.prefetch_rows(sorted(wanted))
+            try:
+                view.prefetch_rows(sorted(wanted))
+            except Exception:
+                self._bump("decision.device_fallbacks")
         return self.build_route_db(
             area_link_states,
             prefix_state,
@@ -1379,7 +1455,10 @@ class SpfSolver:
                 wanted.add(n)
                 for link in ls.links_from_node(n):
                     wanted.add(link.other_node_name(n))
-            view.prefetch_rows(sorted(wanted))
+            try:
+                view.prefetch_rows(sorted(wanted))
+            except Exception:
+                self._bump("decision.device_fallbacks")
         out: dict[str, DecisionRouteDb] = {}
         for node in nodes:
             db = self.build_route_db(

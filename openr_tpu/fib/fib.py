@@ -31,6 +31,7 @@ from ..decision.rib import DecisionRouteUpdate, RibMplsEntry, RibUnicastEntry
 from ..runtime.eventbase import OpenrEventBase
 from ..runtime.queue import QueueClosedError, ReplicateQueue, RQueue
 from ..types import MplsRoute, PerfEvents, UnicastRoute, add_perf_event
+from ..utils.backoff import ExponentialBackoff
 
 log = logging.getLogger(__name__)
 
@@ -66,6 +67,9 @@ class MockFibAgent:
         self.mpls: dict[int, dict[int, MplsRoute]] = {}
         self._alive_since = int(time.time())
         self.fail = False  # raise on every call when set
+        # seeded per-call failure/restart schedule (chaos.FibChaosPlan
+        # duck type: on_call(op) -> "ok" | "fail" | "restart")
+        self.chaos = None
         self.counters = {
             "add_unicast": 0,
             "del_unicast": 0,
@@ -75,9 +79,19 @@ class MockFibAgent:
             "sync_mpls": 0,
         }
 
-    def _check(self) -> None:
+    def _check(self, op: str = "") -> None:
         if self.fail:
             raise RuntimeError("agent unavailable (injected)")
+        plan = self.chaos
+        if plan is not None:
+            verdict = plan.on_call(op)
+            if verdict == "restart":
+                # spontaneous restart: tables wiped, aliveSince bumps, and
+                # the in-flight call dies like a severed thrift channel
+                self.restart()
+                raise RuntimeError(f"agent restarted during {op} (injected)")
+            if verdict == "fail":
+                raise RuntimeError(f"injected agent failure on {op}")
 
     def restart(self) -> None:
         """Simulate agent restart: state wiped, aliveSince bumps."""
@@ -87,7 +101,7 @@ class MockFibAgent:
             self._alive_since = int(time.time() * 1000)  # strictly increases
 
     def add_unicast_routes(self, client_id: int, routes: list[UnicastRoute]) -> None:
-        self._check()
+        self._check("add_unicast_routes")
         with self._lock:
             table = self.unicast.setdefault(client_id, {})
             for route in routes:
@@ -95,7 +109,7 @@ class MockFibAgent:
             self.counters["add_unicast"] += len(routes)
 
     def delete_unicast_routes(self, client_id: int, prefixes: list[str]) -> None:
-        self._check()
+        self._check("delete_unicast_routes")
         with self._lock:
             table = self.unicast.setdefault(client_id, {})
             for prefix in prefixes:
@@ -103,7 +117,7 @@ class MockFibAgent:
             self.counters["del_unicast"] += len(prefixes)
 
     def add_mpls_routes(self, client_id: int, routes: list[MplsRoute]) -> None:
-        self._check()
+        self._check("add_mpls_routes")
         with self._lock:
             table = self.mpls.setdefault(client_id, {})
             for route in routes:
@@ -111,7 +125,7 @@ class MockFibAgent:
             self.counters["add_mpls"] += len(routes)
 
     def delete_mpls_routes(self, client_id: int, labels: list[int]) -> None:
-        self._check()
+        self._check("delete_mpls_routes")
         with self._lock:
             table = self.mpls.setdefault(client_id, {})
             for label in labels:
@@ -119,13 +133,13 @@ class MockFibAgent:
             self.counters["del_mpls"] += len(labels)
 
     def sync_fib(self, client_id: int, routes: list[UnicastRoute]) -> None:
-        self._check()
+        self._check("sync_fib")
         with self._lock:
             self.unicast[client_id] = {r.dest: r for r in routes}
             self.counters["sync_fib"] += 1
 
     def sync_mpls_fib(self, client_id: int, routes: list[MplsRoute]) -> None:
-        self._check()
+        self._check("sync_mpls_fib")
         with self._lock:
             self.mpls[client_id] = {r.top_label: r for r in routes}
             self.counters["sync_mpls"] += 1
@@ -139,7 +153,7 @@ class MockFibAgent:
             return list(self.mpls.get(client_id, {}).values())
 
     def alive_since(self) -> int:
-        self._check()
+        self._check("alive_since")
         with self._lock:
             return self._alive_since
 
@@ -195,8 +209,11 @@ class Fib(OpenrEventBase):
         self.dryrun = dryrun
         self.enable_segment_routing = enable_segment_routing
         self._keepalive_interval_s = keepalive_interval_s
-        self._sync_backoff_bounds = (sync_initial_backoff_s, sync_max_backoff_s)
-        self._sync_backoff_s = 0.0
+        # shared audited backoff (utils.backoff) instead of a hand-rolled
+        # doubling — the KvStore peer FSM uses the same class
+        self._sync_backoff = ExponentialBackoff(
+            sync_initial_backoff_s, sync_max_backoff_s
+        )
 
         self.route_state = RouteState()
         self._do_not_install: set[str] = set()
@@ -336,11 +353,9 @@ class Fib(OpenrEventBase):
         self._sync_timer = self.schedule_timeout(delay_s, self._sync_fib)
 
     def _schedule_sync_backoff(self) -> None:
-        lo, hi = self._sync_backoff_bounds
-        self._sync_backoff_s = (
-            lo if self._sync_backoff_s == 0 else min(self._sync_backoff_s * 2, hi)
-        )
-        self._schedule_sync(self._sync_backoff_s)
+        self._bump("fib.sync_retries")
+        self._sync_backoff.report_error()
+        self._schedule_sync(self._sync_backoff.get_current_backoff())
 
     def _sync_fib(self) -> None:
         self._sync_timer = None
@@ -360,7 +375,7 @@ class Fib(OpenrEventBase):
             was_dirty = self.route_state.dirty
             self.route_state.synced = True
             self.route_state.dirty = False
-            self._sync_backoff_s = 0.0
+            self._sync_backoff.report_success()
             if was_dirty and self._fib_updates_queue is not None:
                 # updates absorbed while unsynced (or failed incrementally)
                 # were never published; emit the reconciled full state so

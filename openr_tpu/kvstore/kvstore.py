@@ -272,9 +272,19 @@ class InProcessTransport:
     def __init__(self) -> None:
         self._stores: dict[str, "KvStore"] = {}
         self._partitioned: set[frozenset[str]] = set()
+        # seeded per-edge failure injector (chaos.KvChaosInjector duck
+        # type: check(op, src, dst) raises TransportError on schedule)
+        self._chaos = None
 
     def register(self, addr: str, store: "KvStore") -> None:
         self._stores[addr] = store
+
+    def set_chaos(self, injector) -> None:
+        self._chaos = injector
+
+    def _chaos_check(self, op: str, src: str, dst: str) -> None:
+        if self._chaos is not None:
+            self._chaos.check(op, src, dst)
 
     def set_partitioned(self, a: str, b: str, partitioned: bool) -> None:
         key = frozenset((a, b))
@@ -305,6 +315,7 @@ class _BoundInProcessTransport:
     async def full_dump(
         self, peer: PeerSpec, area: str, params: KeyDumpParams
     ) -> Publication:
+        self._fabric._chaos_check("full_dump", self.addr, peer.peer_addr)
         store = self._fabric._target(self.addr, peer)
         return await asyncio.wrap_future(
             store.run_in_event_base_thread(
@@ -315,6 +326,7 @@ class _BoundInProcessTransport:
     async def key_set(
         self, peer: PeerSpec, area: str, params: KeySetParams
     ) -> None:
+        self._fabric._chaos_check("key_set", self.addr, peer.peer_addr)
         store = self._fabric._target(self.addr, peer)
         await asyncio.wrap_future(
             store.run_in_event_base_thread(
@@ -1237,6 +1249,7 @@ class KvStoreDb:
         peer = self.peers.get(peer_name)
         if peer is None:
             return
+        self._bump("kvstore.full_sync_retries")
         peer.backoff.report_error()
         peer.spec.state = get_next_state(
             peer.spec.state, KvStorePeerEvent.THRIFT_API_ERROR

@@ -83,18 +83,18 @@ class OpenrDaemon:
         self.fib_updates_queue: ReplicateQueue = ReplicateQueue()
         self.log_sample_queue: ReplicateQueue = ReplicateQueue()
         self.netlink_events_queue = netlink_events_queue or ReplicateQueue()
-        self._queues = [
-            self.kvstore_updates_queue,
-            self.kvstore_sync_events_queue,
-            self.interface_updates_queue,
-            self.neighbor_updates_queue,
-            self.peer_updates_queue,
-            self.prefix_updates_queue,
-            self.route_updates_queue,
-            self.static_routes_queue,
-            self.fib_updates_queue,
-            self.log_sample_queue,
-        ]
+        self._queues = {
+            "kvstore_updates": self.kvstore_updates_queue,
+            "kvstore_sync_events": self.kvstore_sync_events_queue,
+            "interface_updates": self.interface_updates_queue,
+            "neighbor_updates": self.neighbor_updates_queue,
+            "peer_updates": self.peer_updates_queue,
+            "prefix_updates": self.prefix_updates_queue,
+            "route_updates": self.route_updates_queue,
+            "static_routes": self.static_routes_queue,
+            "fib_updates": self.fib_updates_queue,
+            "log_sample": self.log_sample_queue,
+        }
 
         # -- watchdog (reference: Main.cpp:295-300) --------------------------
         self.watchdog: Optional[Watchdog] = None
@@ -318,6 +318,8 @@ class OpenrDaemon:
             kvstore_updates_queue=self.kvstore_updates_queue,
             fib_updates_queue=self.fib_updates_queue,
             config_store=self.config_store,
+            watchdog=self.watchdog,
+            queues=self._queues,
         )
         self.ctrl_server = CtrlServer(
             handler,
@@ -377,7 +379,7 @@ class OpenrDaemon:
             self._plugin = None
         if self.watchdog is not None:
             self.watchdog.stop()
-        for queue in self._queues:
+        for queue in self._queues.values():
             queue.close()
         modules = [
             self.thrift_shim,

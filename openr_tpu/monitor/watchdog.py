@@ -40,6 +40,14 @@ class Watchdog:
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.fired: Optional[str] = None
+        self.counters: dict[str, int] = {
+            "watchdog.stall_events": 0,
+            "watchdog.fired": 0,
+        }
+
+    def get_counters(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self.counters)
 
     def add_evb(self, evb: OpenrEventBase) -> None:
         """Reference: Watchdog::addEvb (Watchdog.h:32)."""
@@ -64,26 +72,36 @@ class Watchdog:
         now = time.monotonic()
         with self._lock:
             evbs = list(self._evbs)
+        # scan EVERY module before deciding: one wedged thread must not
+        # mask another stall or the memory check (an early return here
+        # previously skipped both)
+        reasons: list[str] = []
+        stalls = 0
         for evb in evbs:
             if not evb.is_running:
                 continue
             stall = now - evb.get_timestamp()
             if stall > self._thread_timeout_s:
-                self._fire_crash(
-                    f"thread {evb.name!r} stalled for {stall:.0f}s"
-                )
-                return
+                stalls += 1
+                reasons.append(f"thread {evb.name!r} stalled for {stall:.0f}s")
         rss = SystemMetrics.rss_bytes()
         if rss is not None and rss > self._max_memory_bytes:
-            self._fire_crash(
+            reasons.append(
                 f"memory limit exceeded: rss={rss} > {self._max_memory_bytes}"
             )
+        if stalls:
+            with self._lock:
+                self.counters["watchdog.stall_events"] += stalls
+        if reasons:
+            self._fire_crash("; ".join(reasons))
 
     def _fire_crash(self, reason: str) -> None:
         """Reference: Watchdog::fireCrash (Watchdog.cpp:110-122) — abort so
         the supervisor (systemd) restarts the daemon."""
         log.critical("watchdog: %s", reason)
         self.fired = reason
+        with self._lock:
+            self.counters["watchdog.fired"] += 1
         if self._on_crash is not None:
             self._on_crash(reason)
         else:

@@ -58,14 +58,16 @@ class RQueue(Generic[T]):
 
 
 class RWQueue(Generic[T]):
-    def __init__(self) -> None:
+    def __init__(self, maxlen: Optional[int] = None) -> None:
         self._items: deque[T] = deque()
+        self._maxlen = maxlen
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._closed = False
         self._async_waiters: list[tuple[asyncio.AbstractEventLoop, asyncio.Future]] = []
         self._num_pushed = 0
         self._num_read = 0
+        self._num_overflows = 0
 
     # -- write side ---------------------------------------------------------
 
@@ -73,6 +75,12 @@ class RWQueue(Generic[T]):
         with self._lock:
             if self._closed:
                 return False
+            if self._maxlen is not None and len(self._items) >= self._maxlen:
+                # bounded queue: shed the OLDEST item (routing deltas are
+                # superseded by later state; blocking the producer would
+                # wedge the pushing module's event base instead)
+                self._items.popleft()
+                self._num_overflows += 1
             self._items.append(item)
             self._num_pushed += 1
             self._cond.notify()
@@ -160,6 +168,7 @@ class RWQueue(Generic[T]):
                 "size": len(self._items),
                 "num_pushed": self._num_pushed,
                 "num_read": self._num_read,
+                "num_overflows": self._num_overflows,
             }
 
 
@@ -167,11 +176,12 @@ class ReplicateQueue(Generic[T]):
     """One writer, N reader queues (reference:
     openr/messaging/ReplicateQueue.h:23)."""
 
-    def __init__(self) -> None:
+    def __init__(self, maxlen: Optional[int] = None) -> None:
         self._lock = threading.Lock()
         self._readers: list[RWQueue[T]] = []
         self._closed = False
         self._num_writes = 0
+        self._maxlen = maxlen  # applied to each per-reader queue
 
     def push(self, item: T) -> bool:
         with self._lock:
@@ -189,7 +199,7 @@ class ReplicateQueue(Generic[T]):
         with self._lock:
             if self._closed:
                 raise QueueClosedError("replicate queue closed")
-            q: RWQueue[T] = RWQueue()
+            q: RWQueue[T] = RWQueue(maxlen=self._maxlen)
             self._readers.append(q)
             return RQueue(q)
 
@@ -210,6 +220,25 @@ class ReplicateQueue(Generic[T]):
         with self._lock:
             return self._num_writes
 
+    def stats(self) -> dict[str, int]:
+        """Aggregated reader stats: depth is the deepest per-reader
+        backlog (the consumer the producers are actually waiting on)."""
+        with self._lock:
+            readers = [q for q in self._readers if not q.is_closed()]
+            writes = self._num_writes
+        depth = 0
+        overflows = 0
+        for q in readers:
+            st = q.stats()
+            depth = max(depth, st["size"])
+            overflows += st["num_overflows"]
+        return {
+            "depth": depth,
+            "writes": writes,
+            "overflows": overflows,
+            "readers": len(readers),
+        }
+
     def close(self) -> None:
         with self._lock:
             if self._closed:
@@ -218,3 +247,15 @@ class ReplicateQueue(Generic[T]):
             readers = list(self._readers)
         for q in readers:
             q.close()
+
+
+def queue_counters(queues: dict[str, "ReplicateQueue"]) -> dict[str, int]:
+    """fb303-style counters for a named set of replicate queues (the
+    daemon's inter-module fabric): queue.<name>.{depth,writes,overflows,
+    readers}.  Overflow is the first thing chaos runs surface — a
+    consumer wedged behind a fault shows up here before anywhere else."""
+    out: dict[str, int] = {}
+    for name, queue in queues.items():
+        for key, val in queue.stats().items():
+            out[f"queue.{name}.{key}"] = val
+    return out

@@ -1,0 +1,724 @@
+"""Chaos suite: seeded fault injection + graceful degradation.
+
+Three layers, mirroring the chaos package:
+
+- injector unit tests proving every fault schedule replays bit-for-bit
+  from its seed (the foundation the scenario replay assertion rests on);
+- degradation-ladder tests on live daemons: device SPF dispatch failure
+  falls back to the host oracle, a rebuild failure falls back to a full
+  host-only recompute, and in both cases the route publication stream
+  is never dropped or duplicated;
+- the scripted multi-node scenario (link flap + lossy links + KvStore
+  partition/heal + Fib agent crashes + a daemon restart through Spark
+  GR) asserting bit-exact convergence to host-oracle routes after heal,
+  twice from the same seed with matching event logs.
+
+A failing randomized soak logs its seed (OPENR_CHAOS_SEED) so the exact
+run replays locally.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+
+import pytest
+
+from openr_tpu.chaos import (
+    ChaosEventLog,
+    ChaosIoProvider,
+    ChaosScenario,
+    ChaosSpfBackend,
+    FibChaosPlan,
+    KvChaosInjector,
+    fib_unicast_routes,
+    oracle_route_dbs,
+)
+from openr_tpu.chaos.chaos import SCENARIO_STREAM, wait_until
+from openr_tpu.chaos.scenario import fib_matches_oracle
+from openr_tpu.ctrl import OpenrCtrlHandler
+from openr_tpu.decision.spf_solver import HostSpfBackend
+from openr_tpu.fib import MockFibAgent
+from openr_tpu.kvstore import InProcessTransport
+from openr_tpu.main import OpenrDaemon
+from openr_tpu.monitor.watchdog import Watchdog
+from openr_tpu.runtime.queue import ReplicateQueue, RWQueue, queue_counters
+from openr_tpu.spark import Spark, SparkConfig, SparkNeighState
+from openr_tpu.types import (
+    InterfaceDatabase,
+    InterfaceInfo,
+    LinkEvent,
+    NeighborEventType,
+    PrefixEntry,
+    PrefixType,
+    normalize_prefix,
+)
+
+from test_system import make_config
+
+pytestmark = pytest.mark.chaos
+
+FIB_CLIENT = 786
+
+
+# -- seeded schedules replay bit-for-bit -------------------------------------
+
+
+class TestDeterministicSchedules:
+    def _link_plans(self, seed: int, n: int = 300):
+        fabric = ChaosIoProvider(seed=seed)
+        fabric.set_link_profile(
+            "a", "b", drop=0.3, dup=0.2, reorder=0.2, jitter_s=0.01
+        )
+        plans = [fabric._plan_delivery("a", "b") for _ in range(n)]
+        return plans, fabric.log.streams()
+
+    def test_link_schedule_replays_from_seed(self):
+        plans1, log1 = self._link_plans(7)
+        plans2, log2 = self._link_plans(7)
+        assert plans1 == plans2
+        assert log1 == log2
+        plans3, _ = self._link_plans(8)
+        assert plans1 != plans3
+
+    def test_unprofiled_traffic_does_not_shift_the_schedule(self):
+        # packets sent before the profile attaches (timing-dependent in
+        # count) must not consume seeded draws — the k-th PROFILED
+        # packet's fate is what replays
+        fabric1 = ChaosIoProvider(seed=11)
+        fabric2 = ChaosIoProvider(seed=11)
+        for _ in range(17):  # pre-profile traffic only on fabric1
+            fabric1._plan_delivery("a", "b")
+        for fabric in (fabric1, fabric2):
+            fabric.set_link_profile("a", "b", drop=0.5)
+        plans1 = [fabric1._plan_delivery("a", "b") for _ in range(100)]
+        plans2 = [fabric2._plan_delivery("a", "b") for _ in range(100)]
+        assert plans1 == plans2
+
+    def test_partition_blocks_without_consuming_draws(self):
+        fabric1 = ChaosIoProvider(seed=3)
+        fabric2 = ChaosIoProvider(seed=3)
+        for fabric in (fabric1, fabric2):
+            fabric.set_link_profile("a", "b", drop=0.5)
+        fabric1.set_partitioned("a", "b", True)
+        assert [fabric1._plan_delivery("a", "b") for _ in range(9)] == [[]] * 9
+        fabric1.set_partitioned("a", "b", False)
+        plans1 = [fabric1._plan_delivery("a", "b") for _ in range(50)]
+        plans2 = [fabric2._plan_delivery("a", "b") for _ in range(50)]
+        assert plans1 == plans2
+
+    def test_fib_plan_replays_from_seed(self):
+        def verdicts(seed):
+            plan = FibChaosPlan(seed, fail_prob=0.2, restart_prob=0.1)
+            return [plan.on_call("sync_fib") for _ in range(200)]
+
+        assert verdicts(5) == verdicts(5)
+        assert verdicts(5) != verdicts(6)
+
+    def test_kv_injector_replays_from_seed(self):
+        def outcomes(seed):
+            injector = KvChaosInjector(seed, full_dump_fail=0.4)
+            out = []
+            for _ in range(100):
+                try:
+                    injector.check("full_dump", "x", "y")
+                    out.append("ok")
+                except Exception:
+                    out.append("fail")
+            return out
+
+        assert outcomes(9) == outcomes(9)
+        assert "fail" in outcomes(9)
+        assert outcomes(9) != outcomes(10)
+
+    def test_event_log_matching_semantics(self):
+        a, b = ChaosEventLog(), ChaosEventLog()
+        for log in (a, b):
+            log.append(SCENARIO_STREAM, "step-1")
+            log.append("link:x->y", "0:drop")
+        # one run observed more traffic: common prefix still matches
+        a.append("link:x->y", "4:drop")
+        assert a.matches(b) and b.matches(a)
+        # scenario streams must be identical, not prefix-equal
+        a.append(SCENARIO_STREAM, "step-2")
+        assert not a.matches(b)
+        b.append(SCENARIO_STREAM, "step-2")
+        assert a.matches(b)
+        # a diverging fault decision breaks the match
+        b.append("link:x->y", "4:reorder")
+        assert not a.matches(b)
+
+
+# -- fault hooks on the agent/transport seams --------------------------------
+
+
+class TestFibAgentChaosHook:
+    def test_injected_failures_and_restarts(self):
+        agent = MockFibAgent()
+        agent.chaos = FibChaosPlan(1, fail_prob=1.0, fail_ops={"sync_fib"})
+        agent.add_unicast_routes(FIB_CLIENT, [])  # unlisted op: untouched
+        with pytest.raises(RuntimeError, match="injected"):
+            agent.sync_fib(FIB_CLIENT, [])
+        agent.chaos.disarm()
+        agent.sync_fib(FIB_CLIENT, [])
+
+        agent2 = MockFibAgent()
+        before = agent2.alive_since()
+        agent2.chaos = FibChaosPlan(2, restart_prob=1.0)
+        with pytest.raises(RuntimeError, match="restarted"):
+            agent2.sync_fib(FIB_CLIENT, [])
+        agent2.chaos = None
+        assert agent2.alive_since() > before  # restart detected by keepalive
+        assert agent2.unicast == {}  # tables wiped by the restart
+
+
+# -- watchdog: every stall reported, memory always checked -------------------
+
+
+class _StubEvb:
+    def __init__(self, name: str, ts: float, running: bool = True) -> None:
+        self.name = name
+        self.is_running = running
+        self._ts = ts
+
+    def get_timestamp(self) -> float:
+        return self._ts
+
+
+class TestWatchdog:
+    def test_reports_every_stall_and_always_checks_memory(self):
+        fired: list[str] = []
+        wd = Watchdog(
+            thread_timeout_s=10.0, max_memory_bytes=1, on_crash=fired.append
+        )
+        now = time.monotonic()
+        wd.add_evb(_StubEvb("alpha", now - 100))
+        wd.add_evb(_StubEvb("beta", now))  # healthy
+        wd.add_evb(_StubEvb("gamma", now - 50))
+        wd.check_once()
+        assert len(fired) == 1
+        assert "'alpha'" in fired[0] and "'gamma'" in fired[0]
+        assert "'beta'" not in fired[0]
+        # one wedged thread no longer masks the memory check
+        assert "memory limit exceeded" in fired[0]
+        counters = wd.get_counters()
+        assert counters["watchdog.stall_events"] == 2
+        assert counters["watchdog.fired"] == 1
+
+    def test_healthy_modules_do_not_fire(self):
+        fired: list[str] = []
+        wd = Watchdog(
+            thread_timeout_s=300.0,
+            max_memory_bytes=1 << 60,
+            on_crash=fired.append,
+        )
+        wd.add_evb(_StubEvb("alpha", time.monotonic()))
+        wd.check_once()
+        assert not fired
+        assert wd.get_counters() == {
+            "watchdog.stall_events": 0,
+            "watchdog.fired": 0,
+        }
+
+
+# -- bounded queues: overflow counters through the fb303 path ----------------
+
+
+class TestQueueCounters:
+    def test_bounded_rwqueue_sheds_oldest(self):
+        q: RWQueue[int] = RWQueue(maxlen=2)
+        for i in range(3):
+            q.push(i)
+        stats = q.stats()
+        assert stats["size"] == 2 and stats["num_overflows"] == 1
+        assert q.get(timeout=1) == 1  # 0 was shed, newest state retained
+
+    def test_replicate_queue_stats_aggregate_readers(self):
+        rq: ReplicateQueue[int] = ReplicateQueue(maxlen=2)
+        rq.get_reader()
+        rq.get_reader()
+        for i in range(5):
+            rq.push(i)
+        assert rq.stats() == {
+            "depth": 2,
+            "writes": 5,
+            "overflows": 6,
+            "readers": 2,
+        }
+
+    def test_counters_surface_through_ctrl_and_shim_source(self):
+        rq: ReplicateQueue[int] = ReplicateQueue(maxlen=1)
+        rq.get_reader()
+        rq.push(1)
+        rq.push(2)
+        wd = Watchdog(on_crash=lambda reason: None)
+        handler = OpenrCtrlHandler(
+            "node", watchdog=wd, queues={"route_updates": rq}
+        )
+        # _all_counters is exactly what the thrift shim's fb303
+        # getCounters serves (main.py wires counters_fn=handler._all_counters)
+        counters = handler._all_counters()
+        assert counters["queue.route_updates.overflows"] == 1
+        assert counters["queue.route_updates.depth"] == 1
+        assert counters["queue.route_updates.writes"] == 2
+        assert counters["queue.route_updates.readers"] == 1
+        assert counters["watchdog.stall_events"] == 0
+        assert queue_counters({"x": rq})["queue.x.writes"] == 2
+
+
+# -- multi-daemon fixture over the chaos fabrics -----------------------------
+
+
+class ChaosRing:
+    """RingFixture (tests/test_system.py) over the chaos fabrics: a
+    seeded ChaosIoProvider for Spark and an InProcessTransport with a
+    seeded KvChaosInjector, all sharing one ChaosEventLog."""
+
+    def __init__(
+        self,
+        n: int,
+        seed: int,
+        *,
+        kv_full_dump_fail: float = 0.0,
+        kv_armed: bool = False,
+    ) -> None:
+        self.n = n
+        self.seed = seed
+        self.log = ChaosEventLog()
+        self.spark_fabric = ChaosIoProvider(seed=seed, log_=self.log)
+        self.kv_fabric = InProcessTransport()
+        self.kv_chaos = KvChaosInjector(
+            seed, full_dump_fail=kv_full_dump_fail, log_=self.log
+        )
+        if not kv_armed:
+            self.kv_chaos.disarm()
+        self.kv_fabric.set_chaos(self.kv_chaos)
+        self.daemons: list[OpenrDaemon] = [self._build(i) for i in range(n)]
+        for daemon in self.daemons:
+            daemon.start()
+        for i in range(n):
+            j = (i + 1) % n
+            if n == 2 and i == 1:
+                break  # single link for a 2-ring
+            self.spark_fabric.connect(
+                f"openr-{i}", f"if-{i}-{j}", f"openr-{j}", f"if-{j}-{i}"
+            )
+        for i in range(n):
+            self._push_link_events(i)
+
+    def _build(self, i: int) -> OpenrDaemon:
+        name = f"openr-{i}"
+        addr = f"fe80::{name}"
+        daemon = OpenrDaemon(
+            make_config(name),
+            io_provider=self.spark_fabric.endpoint(name),
+            kvstore_transport=self.kv_fabric.bind(addr),
+            spark_v6_addr=addr,
+        )
+        self.kv_fabric.register(addr, daemon.kvstore)
+        return daemon
+
+    def _push_link_events(self, i: int) -> None:
+        j, k = (i + 1) % self.n, (i - 1) % self.n
+        daemon = self.daemons[i]
+        daemon.netlink_events_queue.push(LinkEvent(f"if-{i}-{j}", 1, True))
+        if self.n > 2:
+            daemon.netlink_events_queue.push(LinkEvent(f"if-{i}-{k}", 2, True))
+
+    def advertise_loopbacks(self) -> None:
+        for i, daemon in enumerate(self.daemons):
+            daemon.prefix_manager.advertise_prefixes(
+                PrefixType.LOOPBACK, [PrefixEntry(prefix=f"fc00:{i}::/64")]
+            )
+
+    def prefix_exists(self, daemon: OpenrDaemon, prefix: str) -> bool:
+        table = daemon.fib_agent.unicast.get(FIB_CLIENT, {})
+        return normalize_prefix(prefix) in table
+
+    def full_mesh(self) -> bool:
+        for i, daemon in enumerate(self.daemons):
+            for j in range(self.n):
+                if i != j and not self.prefix_exists(daemon, f"fc00:{j}::/64"):
+                    return False
+        return True
+
+    def respawn(self, i: int) -> OpenrDaemon:
+        """Restart daemon i through Spark graceful restart: announce the
+        restart, tear down, rebuild on the SAME fabric endpoints, and
+        re-advertise its loopback."""
+        old = self.daemons[i]
+        for _ in range(3):  # repeat past seeded packet loss
+            old.spark.flood_restarting_msg()
+        old.stop()
+        daemon = self._build(i)
+        self.daemons[i] = daemon
+        daemon.start()
+        self._push_link_events(i)
+        daemon.prefix_manager.advertise_prefixes(
+            PrefixType.LOOPBACK, [PrefixEntry(prefix=f"fc00:{i}::/64")]
+        )
+        return daemon
+
+    def stop(self) -> None:
+        for daemon in self.daemons:
+            daemon.stop()
+
+
+def _set_in_decision(daemon: OpenrDaemon, fn) -> None:
+    """Mutate decision-thread state from the test thread, safely."""
+    daemon.decision.run_in_event_base_thread(fn).result()
+
+
+# -- degradation ladder on live daemons --------------------------------------
+
+
+class TestDegradationLadder:
+    def test_device_dispatch_failure_falls_back_to_host_oracle(self):
+        ring = ChaosRing(2, seed=42)
+        try:
+            ring.advertise_loopbacks()
+            assert wait_until(ring.full_mesh, 20)
+            d0 = ring.daemons[0]
+            solver = d0.decision.spf_solver
+            # every device dispatch now fails; the solver must serve
+            # routes from its host oracle instead of dropping the rebuild
+            backend = ChaosSpfBackend(
+                HostSpfBackend(), seed=1, fail_prob=1.0, log_=ring.log
+            )
+            _set_in_decision(d0, lambda: setattr(solver, "spf", backend))
+            route_queue = d0.route_updates_queue
+            writes_before = route_queue.stats()["writes"]
+            fallbacks_before = d0.decision.get_counters().get(
+                "decision.device_fallbacks", 0
+            )
+            ring.daemons[1].prefix_manager.advertise_prefixes(
+                PrefixType.LOOPBACK, [PrefixEntry(prefix="fc00:99::/64")]
+            )
+            assert wait_until(
+                lambda: ring.prefix_exists(d0, "fc00:99::/64"), 20
+            )
+            counters = d0.decision.get_counters()
+            assert (
+                counters.get("decision.device_fallbacks", 0) > fallbacks_before
+            )
+            # zero dropped/duplicated publications: every rebuild pushed,
+            # every reader drained every push, nothing shed
+            stats = route_queue.stats()
+            assert stats["writes"] > writes_before
+            assert stats["overflows"] == 0
+            assert wait_until(
+                lambda: route_queue.stats()["depth"] == 0, 10
+            )
+            # and the published routes are bit-exact host-oracle routes
+            assert wait_until(lambda: fib_matches_oracle(d0), 10), (
+                fib_unicast_routes(d0),
+                oracle_route_dbs(d0),
+            )
+        finally:
+            ring.stop()
+
+    def test_rebuild_failure_never_drops_the_publication(self):
+        ring = ChaosRing(2, seed=43)
+        try:
+            ring.advertise_loopbacks()
+            assert wait_until(ring.full_mesh, 20)
+            d0 = ring.daemons[0]
+            solver = d0.decision.spf_solver
+            orig = solver.create_route_for_prefix_or_get_static_route
+            state = {"armed": True}
+
+            def flaky(*args, **kwargs):
+                if state["armed"]:
+                    state["armed"] = False
+                    raise RuntimeError("injected rebuild failure")
+                return orig(*args, **kwargs)
+
+            _set_in_decision(
+                d0,
+                lambda: setattr(
+                    solver, "create_route_for_prefix_or_get_static_route", flaky
+                ),
+            )
+            ring.daemons[1].prefix_manager.advertise_prefixes(
+                PrefixType.LOOPBACK, [PrefixEntry(prefix="fc00:9::/64")]
+            )
+            # the bottom rung recomputes on the host oracle and still
+            # publishes: the route lands despite the injected failure
+            assert wait_until(
+                lambda: ring.prefix_exists(d0, "fc00:9::/64"), 20
+            )
+            counters = d0.decision.get_counters()
+            assert counters.get("decision.route_rebuild_fallbacks", 0) >= 1
+            assert counters.get("decision.device_fallbacks", 0) >= 1
+            assert isinstance(solver.spf, HostSpfBackend)  # demoted
+            assert wait_until(lambda: fib_matches_oracle(d0), 10)
+        finally:
+            ring.stop()
+
+    def test_fib_sync_retries_with_backoff_then_recovery(self):
+        ring = ChaosRing(2, seed=44)
+        try:
+            ring.advertise_loopbacks()
+            assert wait_until(ring.full_mesh, 20)
+            d0 = ring.daemons[0]
+            # all programming + syncs fail: Fib must retry on backoff and
+            # count every retry
+            d0.fib_agent.chaos = FibChaosPlan(
+                3,
+                fail_prob=1.0,
+                fail_ops={"add_unicast_routes", "sync_fib"},
+                log_=ring.log,
+            )
+            ring.daemons[1].prefix_manager.advertise_prefixes(
+                PrefixType.LOOPBACK, [PrefixEntry(prefix="fc00:55::/64")]
+            )
+            assert wait_until(
+                lambda: d0.fib.counters.get("fib.sync_retries", 0) >= 2, 20
+            )
+            d0.fib_agent.chaos.disarm()
+            assert wait_until(
+                lambda: ring.prefix_exists(d0, "fc00:55::/64"), 20
+            )
+        finally:
+            ring.stop()
+
+    def test_kvstore_full_sync_retries_then_recovery(self):
+        ring = ChaosRing(2, seed=45, kv_full_dump_fail=1.0, kv_armed=True)
+        try:
+            assert wait_until(
+                lambda: ring.daemons[0]
+                .kvstore.get_counters()
+                .get("kvstore.full_sync_retries", 0)
+                >= 1,
+                20,
+            )
+            ring.kv_chaos.disarm()
+            ring.advertise_loopbacks()
+            assert wait_until(ring.full_mesh, 25)
+        finally:
+            ring.stop()
+
+
+# -- Spark graceful restart under seeded packet loss -------------------------
+
+GR_CFG = SparkConfig(
+    hello_time_s=0.2,
+    fastinit_hello_time_s=0.02,
+    keepalive_time_s=0.05,
+    hold_time_s=0.5,
+    graceful_restart_time_s=3.0,
+    negotiate_hold_time_s=0.5,
+)
+
+
+def _spark_node(fabric: ChaosIoProvider, name: str, if_name: str):
+    if_queue: ReplicateQueue = ReplicateQueue()
+    nbr_queue: ReplicateQueue = ReplicateQueue()
+    reader = nbr_queue.get_reader()
+    spark = Spark(
+        name, if_queue.get_reader(), nbr_queue, fabric.endpoint(name),
+        config=GR_CFG,
+    )
+    spark.run()
+    if_queue.push(
+        InterfaceDatabase(
+            this_node_name=name,
+            interfaces={
+                if_name: InterfaceInfo(if_name=if_name, is_up=True, if_index=1)
+            },
+        )
+    )
+    return spark, if_queue, reader
+
+
+class TestSparkGrUnderLoss:
+    def test_adjacency_survives_restart_through_gr_hold(self):
+        fabric = ChaosIoProvider(seed=1234)
+        fabric.set_link_profile("node1", "node2", drop=0.2)
+        fabric.connect("node1", "if1", "node2", "if2")
+        sp1, ifq1, events1 = _spark_node(fabric, "node1", "if1")
+        sp2, ifq2, _ = _spark_node(fabric, "node2", "if2")
+        sparks = [sp1, sp2]
+        try:
+            est = SparkNeighState.ESTABLISHED
+            assert wait_until(
+                lambda: sp1.get_neigh_state("if1", "node2") == est, 15
+            )
+            assert wait_until(
+                lambda: sp2.get_neigh_state("if2", "node1") == est, 15
+            )
+            for _ in range(4):  # repeat the GR announce past 20% loss
+                sp2.flood_restarting_msg()
+            ifq2.close()
+            sp2.stop()
+            sp2.wait_until_stopped(5)
+            assert wait_until(
+                lambda: sp1.get_neigh_state("if1", "node2")
+                == SparkNeighState.RESTART,
+                5,
+            ), "restarting hello lost: GR never engaged"
+            # neighbor comes back on the same fabric endpoints inside the
+            # GR hold window
+            sp2b, ifq2b, _ = _spark_node(fabric, "node2", "if2")
+            sparks.append(sp2b)
+            ifq2 = ifq2b
+            assert wait_until(
+                lambda: sp1.get_neigh_state("if1", "node2") == est, 15
+            )
+            # the adjacency was HELD: restart events published, never DOWN
+            seen = []
+            while True:
+                try:
+                    seen.append(events1.get(timeout=0.1).event_type)
+                except TimeoutError:
+                    break
+            assert NeighborEventType.NEIGHBOR_DOWN not in seen, seen
+            assert NeighborEventType.NEIGHBOR_RESTARTING in seen, seen
+            assert NeighborEventType.NEIGHBOR_RESTARTED in seen, seen
+        finally:
+            ifq1.close()
+            ifq2.close()
+            for spark in sparks:
+                spark.stop()
+            for spark in sparks:
+                spark.wait_until_stopped(5)
+
+
+# -- the scripted multi-node scenario ----------------------------------------
+
+
+def run_chaos_scenario(seed: int):
+    """One 4-node chaos timeline; returns (log, converged, tables, oracle).
+
+    Ring 0-1-2-3-0.  The timeline: converge clean, then a lossy+flapping
+    link 0-1, KvStore sync failures everywhere plus a hard kv partition
+    1-2, Fib agent crash/failure bursts on node 2, a TTL storm, prefix
+    churn — then daemon 3 restarts through Spark GR, everything heals,
+    and every node must converge bit-exactly to its host-oracle routes.
+    """
+    ring = ChaosRing(4, seed, kv_full_dump_fail=0.25)
+    scenario = ChaosScenario(log_=ring.log)
+    try:
+        scenario.step("advertise-loopbacks", ring.advertise_loopbacks)
+        ok = scenario.wait("initial-convergence", ring.full_mesh, 30)
+
+        scenario.step(
+            "lossy-link-0-1",
+            lambda: ring.spark_fabric.set_link_profile(
+                "openr-0", "openr-1",
+                drop=0.2, dup=0.1, reorder=0.1, jitter_s=0.005,
+            ),
+        )
+        scenario.step("kv-chaos-on", ring.kv_chaos.arm)
+        scenario.step(
+            "flap-0-1-down",
+            lambda: ring.spark_fabric.disconnect(
+                "openr-0", "if-0-1", "openr-1", "if-1-0"
+            ),
+        )
+        def rerouted() -> bool:
+            table = ring.daemons[0].fib_agent.unicast.get(FIB_CLIENT, {})
+            route = table.get(normalize_prefix("fc00:1::/64"))
+            if route is None:
+                return False
+            names = {nh.neighbor_node_name for nh in route.next_hops}
+            return names == {"openr-3"}
+
+        ok &= scenario.wait("rerouted-around-0-1", rerouted, 30)
+        scenario.step(
+            "kv-partition-1-2",
+            lambda: ring.kv_fabric.set_partitioned(
+                "fe80::openr-1", "fe80::openr-2", True
+            ),
+        )
+        scenario.step(
+            "fib-chaos-node-2",
+            lambda: setattr(
+                ring.daemons[2].fib_agent,
+                "chaos",
+                FibChaosPlan(
+                    seed,
+                    fail_prob=0.25,
+                    restart_prob=0.1,
+                    log_=ring.log,
+                    stream="fib:openr-2",
+                ),
+            ),
+        )
+        scenario.step(
+            "ttl-storm",
+            lambda: ring.kv_chaos.ttl_storm(ring.daemons[1].kvstore),
+        )
+        scenario.step(
+            "prefix-churn",
+            lambda: ring.daemons[1].prefix_manager.advertise_prefixes(
+                PrefixType.LOOPBACK, [PrefixEntry(prefix="fc00:33::/64")]
+            ),
+        )
+        scenario.step(
+            "flap-0-1-up",
+            lambda: ring.spark_fabric.connect(
+                "openr-0", "if-0-1", "openr-1", "if-1-0"
+            ),
+        )
+        scenario.step("restart-daemon-3", lambda: ring.respawn(3))
+
+        def heal() -> None:
+            ring.spark_fabric.clear_all_profiles()
+            ring.kv_chaos.disarm()
+            ring.kv_fabric.set_partitioned(
+                "fe80::openr-1", "fe80::openr-2", False
+            )
+            plan = ring.daemons[2].fib_agent.chaos
+            if plan is not None:
+                plan.disarm()
+
+        scenario.step("heal", heal)
+        ok &= scenario.wait("post-heal-mesh", ring.full_mesh, 45)
+        ok &= scenario.wait_converged(ring.daemons, 45)
+        tables = {
+            daemon.config.node_name: fib_unicast_routes(daemon)
+            for daemon in ring.daemons
+        }
+        oracle = {
+            daemon.config.node_name: oracle_route_dbs(daemon)
+            for daemon in ring.daemons
+        }
+        return ring.log, ok, tables, oracle
+    finally:
+        ring.stop()
+
+
+class TestChaosScenario:
+    def test_scenario_converges_to_oracle_and_replays(self):
+        seed = 20260805
+        log1, ok1, tables1, oracle1 = run_chaos_scenario(seed)
+        assert ok1, log1.scenario()
+        assert tables1 == oracle1  # bit-exact host-oracle convergence
+        assert len(tables1) == 4 and all(tables1.values())
+
+        log2, ok2, tables2, oracle2 = run_chaos_scenario(seed)
+        assert ok2, log2.scenario()
+        assert tables2 == oracle2
+        # same seed => same scripted timeline and same fault decisions
+        assert log1.matches(log2), (log1.streams(), log2.streams())
+        assert tables1 == tables2
+
+
+@pytest.mark.slow
+class TestChaosSoak:
+    def test_randomized_soak(self):
+        seed = int(
+            os.environ.get(
+                "OPENR_CHAOS_SEED", random.SystemRandom().randrange(2**31)
+            )
+        )
+        try:
+            log, ok, tables, oracle = run_chaos_scenario(seed)
+            assert ok, log.scenario()
+            assert tables == oracle
+        except AssertionError as exc:
+            raise AssertionError(
+                f"chaos soak failed; replay with OPENR_CHAOS_SEED={seed}: {exc}"
+            ) from exc
