@@ -1,0 +1,16 @@
+"""Static invariant checker for openr_tpu (stdlib-ast only, no jax import).
+
+Three checker families — jit hygiene, thread discipline, counter hygiene —
+documented in docs/ARCHITECTURE.md ("Static invariants").  Run with
+``python -m openr_tpu.analysis openr_tpu/`` or ``scripts/lint.py``.
+"""
+
+from .core import (  # noqa: F401
+    ALL_RULES,
+    AnalysisConfig,
+    Finding,
+    Reporter,
+    Severity,
+    load_config,
+    run_analysis,
+)

@@ -1,0 +1,98 @@
+"""Command line front end: ``python -m openr_tpu.analysis [paths...]``.
+
+Exits nonzero when any unsuppressed finding remains, so it can gate CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from .core import ALL_RULES, load_config, run_analysis
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m openr_tpu.analysis",
+        description=(
+            "openr-tpu static invariant checker: jit hygiene, thread "
+            "discipline, counter hygiene"
+        ),
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        default=["openr_tpu"],
+        help="files or directories to analyze (default: openr_tpu)",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text", dest="fmt"
+    )
+    parser.add_argument(
+        "--show-suppressed",
+        action="store_true",
+        help="also print findings silenced by '# openr: disable=' markers",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="list rule ids and exit"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(ALL_RULES.items()):
+            print(f"{rule:28s} {desc}")
+        return 0
+
+    targets = [Path(p) for p in args.paths]
+    missing = [p for p in targets if not p.exists()]
+    if missing:
+        print(
+            f"error: no such path: {', '.join(map(str, missing))}",
+            file=sys.stderr,
+        )
+        return 2
+
+    config, root = load_config(targets[0])
+    reporter = run_analysis(targets, config, root)
+    findings = reporter.sorted_findings()
+
+    if args.fmt == "json":
+        print(
+            json.dumps(
+                {
+                    "findings": [
+                        {
+                            "rule": f.rule,
+                            "path": f.path,
+                            "line": f.line,
+                            "col": f.col,
+                            "severity": f.severity.value,
+                            "message": f.message,
+                        }
+                        for f in findings
+                    ],
+                    "suppressed": len(reporter.suppressed),
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.format())
+        if args.show_suppressed:
+            for f in sorted(
+                reporter.suppressed, key=lambda f: (f.path, f.line, f.col)
+            ):
+                print(f"(suppressed) {f.format()}")
+        n = len(findings)
+        print(
+            f"{n} finding{'s' if n != 1 else ''} "
+            f"({len(reporter.suppressed)} suppressed)"
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

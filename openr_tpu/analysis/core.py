@@ -1,0 +1,407 @@
+"""Core machinery for the openr-tpu static analyzer.
+
+Pure stdlib (``ast`` + ``tokenize``): the analyzer must stay importable in
+environments without jax so that ``python -m openr_tpu.analysis`` can run as
+a pre-test lint step anywhere, including CI boxes with no accelerator stack.
+
+The pieces here are shared by all three checker families (jit hygiene,
+thread discipline, counter hygiene):
+
+- :class:`Finding` / :class:`Severity` — one diagnostic, pointing at a
+  rule id, file, line and column.
+- suppression parsing — ``# openr: disable=<rule>[,<rule>...]`` on the
+  flagged line (or on a comment line directly above it, for long lines)
+  silences matching findings.  ``# openr: disable=all`` silences every rule
+  on that line.
+- :class:`AnalysisConfig` — loaded from ``[tool.openr-analysis]`` in
+  pyproject.toml.  Python 3.10 has no ``tomllib``, so a minimal parser for
+  the small subset we use (strings, booleans, arrays of strings) backs the
+  stdlib import when it is unavailable.
+- :class:`SourceFile` / :func:`walk_python_files` — parsed-file cache and
+  target discovery.
+"""
+
+from __future__ import annotations
+
+import ast
+import enum
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Iterator, Sequence
+
+
+class Severity(enum.Enum):
+    ERROR = "error"
+    WARNING = "warning"
+
+    def __str__(self) -> str:  # pragma: no cover - trivial
+        return self.value
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic raised by a rule against a source location."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    severity: Severity = Severity.ERROR
+
+    def format(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: "
+            f"{self.severity.value}[{self.rule}] {self.message}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Suppressions
+# ---------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(r"#\s*openr:\s*disable=([A-Za-z0-9_\-,\s]+)")
+
+
+@dataclass
+class Suppressions:
+    """Per-file map of line -> set of suppressed rule ids ('all' wildcard)."""
+
+    by_line: dict[int, set[str]] = field(default_factory=dict)
+    #: suppressions that never matched a finding; reported by --show-unused
+    used: set[tuple[int, str]] = field(default_factory=set)
+
+    def matches(self, line: int, rule: str) -> bool:
+        rules = self.by_line.get(line)
+        if not rules:
+            return False
+        if rule in rules or "all" in rules:
+            self.used.add((line, rule))
+            return True
+        return False
+
+
+def collect_suppressions(source: str) -> Suppressions:
+    """Scan comments for ``# openr: disable=`` markers.
+
+    A marker on a *standalone* comment line applies to the next non-comment
+    line as well, so long statements can carry their suppression above them.
+    """
+    sup = Suppressions()
+    pending: set[str] | None = None
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return sup
+    # Lines that contain any non-comment code, to tell standalone comment
+    # lines apart from trailing comments.
+    code_lines: set[int] = set()
+    for tok in tokens:
+        if tok.type in (
+            tokenize.COMMENT,
+            tokenize.NL,
+            tokenize.NEWLINE,
+            tokenize.INDENT,
+            tokenize.DEDENT,
+            tokenize.ENCODING,
+            tokenize.ENDMARKER,
+        ):
+            continue
+        for ln in range(tok.start[0], tok.end[0] + 1):
+            code_lines.add(ln)
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        line = tok.start[0]
+        sup.by_line.setdefault(line, set()).update(rules)
+        if line not in code_lines:
+            # Standalone comment: also cover the next code line.
+            nxt = min((ln for ln in code_lines if ln > line), default=None)
+            if nxt is not None:
+                sup.by_line.setdefault(nxt, set()).update(rules)
+    return sup
+
+
+# ---------------------------------------------------------------------------
+# Configuration
+# ---------------------------------------------------------------------------
+
+ALL_RULES: dict[str, str] = {
+    # jit hygiene (openr_tpu/analysis/jit.py)
+    "jit-host-sync": "host-sync construct inside a traced (jitted) context",
+    "jit-tracer-branch": "Python control flow on a tracer-derived value",
+    "jit-static-hygiene": "static-arg misuse that breaks caching or tracing",
+    "jit-dispatch-sync": "implicit device->host sync in jit dispatch code",
+    # thread discipline (openr_tpu/analysis/threads.py)
+    "thread-cross-module-write": (
+        "attribute write into another module, bypassing queue/ctrl seams"
+    ),
+    "thread-queue-registration": (
+        "ReplicateQueue created in the daemon but absent from the named-queue dict"
+    ),
+    # counter hygiene (openr_tpu/analysis/counters.py)
+    "counter-name": "counter literal violates the module.name convention",
+    "counter-registry": (
+        "counter bumped but unreachable from OpenrCtrlHandler._all_counters"
+    ),
+    "counter-duplicate": "one counter bumped under two spellings",
+}
+
+
+@dataclass
+class AnalysisConfig:
+    """Knobs read from ``[tool.openr-analysis]`` in pyproject.toml."""
+
+    #: rule ids to run; defaults to every known rule
+    enable: list[str] = field(default_factory=lambda: sorted(ALL_RULES))
+    #: rule ids to drop from `enable`
+    disable: list[str] = field(default_factory=list)
+    #: path prefixes (relative to the package root's parent) skipped entirely
+    exclude: list[str] = field(default_factory=list)
+    #: files/dirs whose call graphs the jit checkers analyze
+    jit_paths: list[str] = field(default_factory=list)
+    #: extra top-level counter prefixes treated as exported (beyond the ones
+    #: discovered by parsing OpenrCtrlHandler._all_counters)
+    counter_extra_prefixes: list[str] = field(default_factory=list)
+    #: attribute names treated as module handles by the thread checker
+    module_attrs: list[str] = field(default_factory=list)
+
+    def active_rules(self) -> set[str]:
+        return {r for r in self.enable if r in ALL_RULES} - set(self.disable)
+
+    def is_excluded(self, path: Path, root: Path) -> bool:
+        try:
+            rel = path.resolve().relative_to(root.resolve()).as_posix()
+        except ValueError:
+            rel = path.as_posix()
+        return any(
+            rel == ex.rstrip("/") or rel.startswith(ex.rstrip("/") + "/")
+            for ex in self.exclude
+        )
+
+
+def _parse_toml_minimal(text: str) -> dict[str, dict[str, object]]:
+    """Parse the tiny TOML subset the analyzer config uses.
+
+    Handles ``[section.headers]``, ``key = "string" | true | false`` and
+    (possibly multiline) arrays of strings.  Python 3.10 ships no tomllib;
+    this keeps the analyzer dependency-free there.
+    """
+    out: dict[str, dict[str, object]] = {}
+    section: dict[str, object] | None = None
+    lines = text.splitlines()
+    i = 0
+    while i < len(lines):
+        raw = lines[i].strip()
+        i += 1
+        if not raw or raw.startswith("#"):
+            continue
+        if raw.startswith("[") and raw.endswith("]"):
+            name = raw[1:-1].strip().strip('"')
+            section = out.setdefault(name, {})
+            continue
+        if section is None or "=" not in raw:
+            continue
+        key, _, val = raw.partition("=")
+        key = key.strip().strip('"')
+        val = val.strip()
+        if val.startswith("["):
+            # Accumulate until the closing bracket (arrays may span lines).
+            buf = val
+            while "]" not in buf and i < len(lines):
+                buf += " " + lines[i].strip()
+                i += 1
+            items = re.findall(r'"((?:[^"\\]|\\.)*)"|\'([^\']*)\'', buf)
+            section[key] = [a if a else b for a, b in items]
+        elif val in ("true", "false"):
+            section[key] = val == "true"
+        else:
+            m = re.match(r'"((?:[^"\\]|\\.)*)"|\'([^\']*)\'', val)
+            if m:
+                section[key] = m.group(1) if m.group(1) is not None else m.group(2)
+    return out
+
+
+def load_config(start: Path) -> tuple[AnalysisConfig, Path]:
+    """Find pyproject.toml at or above `start`; return (config, project root).
+
+    Falls back to defaults (and `start` as root) when no pyproject is found.
+    """
+    cur = start.resolve()
+    if cur.is_file():
+        cur = cur.parent
+    for candidate in [cur, *cur.parents]:
+        py = candidate / "pyproject.toml"
+        if py.is_file():
+            text = py.read_text(encoding="utf-8")
+            try:
+                import tomllib  # Python 3.11+
+
+                data = tomllib.loads(text)
+            except ModuleNotFoundError:
+                data = _parse_toml_minimal(text)
+            tool = data.get("tool", {})
+            if isinstance(tool, dict) and "openr-analysis" in tool:
+                raw = tool["openr-analysis"]
+            else:
+                raw = data.get("tool.openr-analysis", {})
+            cfg = AnalysisConfig()
+            if isinstance(raw, dict):
+                for key in (
+                    "enable",
+                    "disable",
+                    "exclude",
+                    "jit_paths",
+                    "counter_extra_prefixes",
+                    "module_attrs",
+                ):
+                    val = raw.get(key)
+                    if isinstance(val, list):
+                        setattr(cfg, key, [str(v) for v in val])
+            return cfg, candidate
+    return AnalysisConfig(), cur
+
+
+# ---------------------------------------------------------------------------
+# Source files
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SourceFile:
+    """A parsed source file plus its suppression map."""
+
+    path: Path
+    rel: str
+    source: str
+    tree: ast.Module
+    suppressions: Suppressions
+
+    @classmethod
+    def parse(cls, path: Path, root: Path) -> "SourceFile | None":
+        try:
+            source = path.read_text(encoding="utf-8")
+            tree = ast.parse(source, filename=str(path))
+        except (OSError, SyntaxError):
+            return None
+        try:
+            rel = path.resolve().relative_to(root.resolve()).as_posix()
+        except ValueError:
+            rel = path.as_posix()
+        return cls(
+            path=path,
+            rel=rel,
+            source=source,
+            tree=tree,
+            suppressions=collect_suppressions(source),
+        )
+
+
+def walk_python_files(targets: Sequence[Path]) -> Iterator[Path]:
+    seen: set[Path] = set()
+    for target in targets:
+        if target.is_file() and target.suffix == ".py":
+            p = target.resolve()
+            if p not in seen:
+                seen.add(p)
+                yield target
+        elif target.is_dir():
+            for dirpath, dirnames, filenames in os.walk(target):
+                dirnames[:] = [
+                    d for d in dirnames if d != "__pycache__" and not d.startswith(".")
+                ]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        p = (Path(dirpath) / fn).resolve()
+                        if p not in seen:
+                            seen.add(p)
+                            yield Path(dirpath) / fn
+
+
+class Reporter:
+    """Collects findings, applying per-line suppressions."""
+
+    def __init__(self, config: AnalysisConfig) -> None:
+        self.config = config
+        self.findings: list[Finding] = []
+        self.suppressed: list[Finding] = []
+        self._active = config.active_rules()
+        self._seen: set[tuple[str, str, int, int, str]] = set()
+
+    def emit(
+        self,
+        sf: SourceFile,
+        rule: str,
+        node: ast.AST | tuple[int, int],
+        message: str,
+        severity: Severity = Severity.ERROR,
+    ) -> None:
+        if rule not in self._active:
+            return
+        if isinstance(node, tuple):
+            line, col = node
+        else:
+            line = getattr(node, "lineno", 1)
+            col = getattr(node, "col_offset", 0)
+        key = (rule, sf.rel, line, col, message)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        f = Finding(rule, sf.rel, line, col, message, severity)
+        if sf.suppressions.matches(line, rule):
+            self.suppressed.append(f)
+        else:
+            self.findings.append(f)
+
+    def sorted_findings(self) -> list[Finding]:
+        return sorted(self.findings, key=lambda f: (f.path, f.line, f.col, f.rule))
+
+
+def run_analysis(
+    targets: Sequence[Path],
+    config: AnalysisConfig | None = None,
+    root: Path | None = None,
+) -> Reporter:
+    """Run every enabled checker family over `targets`; return the Reporter."""
+    if config is None or root is None:
+        cfg, found_root = load_config(targets[0] if targets else Path.cwd())
+        config = config or cfg
+        root = root or found_root
+
+    files: list[SourceFile] = []
+    for path in walk_python_files(targets):
+        if config.is_excluded(path, root):
+            continue
+        sf = SourceFile.parse(path, root)
+        if sf is not None:
+            files.append(sf)
+
+    reporter = Reporter(config)
+    active = config.active_rules()
+
+    if active & {
+        "jit-host-sync",
+        "jit-tracer-branch",
+        "jit-static-hygiene",
+        "jit-dispatch-sync",
+    }:
+        from . import jit
+
+        jit.check(files, reporter, config, root)
+    if active & {"thread-cross-module-write", "thread-queue-registration"}:
+        from . import threads
+
+        threads.check(files, reporter, config, root)
+    if active & {"counter-name", "counter-registry", "counter-duplicate"}:
+        from . import counters
+
+        counters.check(files, reporter, config, root)
+    return reporter

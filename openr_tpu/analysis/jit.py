@@ -1,0 +1,1015 @@
+"""Jit-hygiene checkers.
+
+Four rules over the ``jax.jit`` call graphs rooted in the configured
+``jit_paths`` (the ops/ kernels and the fleet dispatch layer):
+
+- ``jit-host-sync``: inside a *traced* context (a jitted function, or any
+  function it calls with traced arguments), constructs that force a
+  device->host transfer or fail outright under tracing: ``float()`` /
+  ``int()`` / ``bool()`` on traced values, ``np.asarray`` / ``np.array``,
+  ``.block_until_ready()`` / ``.item()`` / ``.tolist()``, and ``print``.
+- ``jit-tracer-branch``: Python ``if`` / ``while`` / ``assert`` (and
+  conditional expressions) whose test depends on a tracer-derived value.
+  ``x is None`` / ``x is not None`` checks and static extractors
+  (``.shape`` / ``.ndim`` / ``.dtype`` / ``len()``) are exempt — those are
+  concrete at trace time.
+- ``jit-static-hygiene``: ``static_argnames`` naming a missing parameter,
+  ``static_argnums`` out of range, static parameters with non-hashable
+  (list/dict/set) defaults, and call sites passing a non-hashable literal
+  into a static slot — each of these either breaks tracing or defeats the
+  jit cache and recompiles every dispatch.
+- ``jit-dispatch-sync``: in *host* code within the same files, implicit
+  syncs on device-resident values returned by jitted calls —
+  ``bool(ok)`` / ``int(blocks)`` / ``np.asarray(dist)`` and branches on
+  them.  These are the per-dispatch-tax hazards: each one blocks the
+  Python thread on the device stream.  Deliberate fetch points should use
+  a single ``jax.device_get`` and/or carry a suppression explaining why
+  the sync is intended.
+
+The analysis is a fixpoint over an interprocedural "tracedness"
+propagation: jitted roots seed their non-static parameters as traced;
+direct calls (by local name, ``from x import f`` alias, or module-alias
+attribute) into other analyzed files propagate per-parameter flags.
+Method calls are not resolved — the kernels in scope are free functions,
+which keeps the checker sound-enough without a type system.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from .core import AnalysisConfig, Reporter, Severity, SourceFile
+
+# Attributes that are concrete (host) values even on tracers.
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+# Builtins whose result is a host value (and which sync/fail on tracers).
+_CONVERSIONS = {"float", "int", "bool", "complex"}
+# Builtins that never return device values.
+_HOST_BUILTINS = {"len", "isinstance", "range", "enumerate", "zip", "max", "min"}
+# numpy entry points that pull device buffers to host.
+_NUMPY_SYNCS = {"numpy.asarray", "numpy.array", "numpy.copy"}
+# method calls that sync or fail under trace
+_SYNC_METHODS = {"block_until_ready", "item", "tolist"}
+
+
+def _in_jit_paths(rel: str, config: AnalysisConfig) -> bool:
+    for p in config.jit_paths:
+        p = p.rstrip("/")
+        if rel == p or rel.startswith(p + "/"):
+            return True
+    return False
+
+
+def _module_name(rel: str) -> str:
+    parts = rel[:-3].split("/") if rel.endswith(".py") else rel.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+@dataclass
+class FuncRecord:
+    node: ast.FunctionDef | ast.AsyncFunctionDef
+    sf: SourceFile
+    module: str
+    name: str
+    params: list[str] = field(default_factory=list)
+    is_jitted: bool = False
+    static_names: set[str] = field(default_factory=set)
+    jit_site: ast.AST | None = None  # decorator / wrapping call node
+
+    @property
+    def key(self) -> tuple[str, str]:
+        return (self.module, self.name)
+
+
+@dataclass
+class FileIndex:
+    sf: SourceFile
+    module: str
+    #: local name -> ("module", dotted) or ("obj", dotted_module, attr)
+    imports: dict[str, tuple[str, str] | tuple[str, str, str]] = field(
+        default_factory=dict
+    )
+    #: module-level function defs by name
+    funcs: dict[str, FuncRecord] = field(default_factory=dict)
+
+
+class _Index:
+    """Cross-file name resolution over the analyzed file set."""
+
+    def __init__(self, files: list[SourceFile]) -> None:
+        self.by_module: dict[str, FileIndex] = {}
+        self.funcs: dict[tuple[str, str], FuncRecord] = {}
+        for sf in files:
+            fi = FileIndex(sf=sf, module=_module_name(sf.rel))
+            self._index_imports(fi)
+            self._index_functions(fi)
+            self.by_module[fi.module] = fi
+            for rec in fi.funcs.values():
+                self.funcs[rec.key] = rec
+        for fi in self.by_module.values():
+            self._index_jit_roots(fi)
+
+    # -- imports ----------------------------------------------------------
+    def _index_imports(self, fi: FileIndex) -> None:
+        pkg_parts = fi.module.split(".")
+        for node in ast.walk(fi.sf.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    local = a.asname or a.name.split(".")[0]
+                    dotted = a.name if a.asname else a.name.split(".")[0]
+                    fi.imports[local] = ("module", dotted)
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = pkg_parts[: len(pkg_parts) - node.level]
+                    mod = ".".join(base + (node.module.split(".") if node.module else []))
+                else:
+                    mod = node.module or ""
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    local = a.asname or a.name
+                    if (mod + "." + a.name) in _KNOWN_MODULE_PREFIXES or self._looks_like_module(
+                        mod, a.name
+                    ):
+                        fi.imports[local] = ("module", mod + "." + a.name)
+                    else:
+                        fi.imports[local] = ("obj", mod, a.name)
+
+    def _looks_like_module(self, mod: str, name: str) -> bool:
+        # `from ..ops import allsources as asrc` — the imported name is a
+        # sibling module iff an analyzed file maps to that dotted path.
+        return (mod + "." + name) in self.by_module or name in ("numpy",)
+
+    # -- functions --------------------------------------------------------
+    def _index_functions(self, fi: FileIndex) -> None:
+        for node in fi.sf.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi.funcs[node.name] = FuncRecord(
+                    node=node,
+                    sf=fi.sf,
+                    module=fi.module,
+                    name=node.name,
+                    params=_param_names(node),
+                )
+
+    def _index_jit_roots(self, fi: FileIndex) -> None:
+        for rec in fi.funcs.values():
+            for deco in rec.node.decorator_list:
+                statics = self._jit_statics(fi, deco, rec)
+                if statics is not None:
+                    rec.is_jitted = True
+                    rec.static_names |= statics
+                    rec.jit_site = deco
+        # `fast_f = jax.jit(f, static_argnames=...)` at module level
+        for node in fi.sf.tree.body:
+            if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
+                continue
+            call = node.value
+            if self.resolve_dotted(fi, call.func) != "jax.jit":
+                continue
+            if call.args and isinstance(call.args[0], ast.Name):
+                rec = fi.funcs.get(call.args[0].id)
+                if rec is not None:
+                    rec.is_jitted = True
+                    rec.static_names |= _statics_from_call(call, rec)
+                    rec.jit_site = call
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            fi.imports[tgt.id] = ("obj", fi.module, rec.name)
+
+    def _jit_statics(
+        self, fi: FileIndex, deco: ast.AST, rec: FuncRecord
+    ) -> set[str] | None:
+        """Return static param names if `deco` jit-wraps the function."""
+        if self.resolve_dotted(fi, deco) == "jax.jit":
+            return set()
+        if isinstance(deco, ast.Call):
+            fdot = self.resolve_dotted(fi, deco.func)
+            if fdot == "jax.jit":
+                return _statics_from_call(deco, rec)
+            if fdot == "functools.partial" and deco.args:
+                if self.resolve_dotted(fi, deco.args[0]) == "jax.jit":
+                    return _statics_from_call(deco, rec)
+        return None
+
+    # -- resolution -------------------------------------------------------
+    def resolve_dotted(self, fi: FileIndex, node: ast.AST) -> str | None:
+        """Resolve an expression to a dotted path like 'jax.numpy.asarray'."""
+        if isinstance(node, ast.Name):
+            ent = fi.imports.get(node.id)
+            if ent is None:
+                if node.id in fi.funcs:
+                    return fi.module + "." + node.id
+                return None
+            if ent[0] == "module":
+                return ent[1]
+            return ent[1] + "." + ent[2]
+        if isinstance(node, ast.Attribute):
+            base = self.resolve_dotted(fi, node.value)
+            if base is None:
+                return None
+            return base + "." + node.attr
+        return None
+
+    def resolve_func(self, fi: FileIndex, node: ast.AST) -> FuncRecord | None:
+        dotted = self.resolve_dotted(fi, node)
+        if dotted is None:
+            return None
+        mod, _, name = dotted.rpartition(".")
+        return self.funcs.get((mod, name))
+
+
+_KNOWN_MODULE_PREFIXES = {"jax.numpy", "jax.lax", "jax.random", "numpy.linalg"}
+
+
+def _param_names(node: ast.FunctionDef | ast.AsyncFunctionDef | ast.Lambda) -> list[str]:
+    a = node.args
+    return [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+
+
+def _statics_from_call(call: ast.Call, rec: FuncRecord) -> set[str]:
+    out: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            out |= set(_const_strs(kw.value))
+        elif kw.arg == "static_argnums":
+            for idx in _const_ints(kw.value):
+                if 0 <= idx < len(rec.params):
+                    out.add(rec.params[idx])
+    return out
+
+
+def _const_strs(node: ast.AST) -> list[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                out.append(el.value)
+        return out
+    return []
+
+
+def _const_ints(node: ast.AST) -> list[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [
+            el.value
+            for el in node.elts
+            if isinstance(el, ast.Constant) and isinstance(el.value, int)
+        ]
+    return []
+
+
+# ---------------------------------------------------------------------------
+# Traced-context analysis
+# ---------------------------------------------------------------------------
+
+
+class _TracedWalker:
+    """Walk one function body with a set of traced names, emitting findings
+    and enqueuing callees that receive traced arguments."""
+
+    def __init__(
+        self,
+        index: _Index,
+        fi: FileIndex,
+        reporter: Reporter,
+        enqueue,
+    ) -> None:
+        self.index = index
+        self.fi = fi
+        self.reporter = reporter
+        self.enqueue = enqueue
+        self.sf = fi.sf
+
+    # -- tracedness -------------------------------------------------------
+    def traced(self, node: ast.AST, env: set[str]) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in env
+        if isinstance(node, (ast.Constant, ast.JoinedStr)):
+            return False
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self.traced(node.value, env)
+        if isinstance(node, ast.Call):
+            return self._call_traced(node, env)
+        if isinstance(node, ast.Subscript):
+            return self.traced(node.value, env) or self.traced(node.slice, env)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.traced(el, env) for el in node.elts)
+        if isinstance(node, ast.Starred):
+            return self.traced(node.value, env)
+        if isinstance(node, ast.BinOp):
+            return self.traced(node.left, env) or self.traced(node.right, env)
+        if isinstance(node, ast.UnaryOp):
+            return self.traced(node.operand, env)
+        if isinstance(node, ast.BoolOp):
+            return any(self.traced(v, env) for v in node.values)
+        if isinstance(node, ast.Compare):
+            return self.traced(node.left, env) or any(
+                self.traced(c, env) for c in node.comparators
+            )
+        if isinstance(node, ast.IfExp):
+            return any(
+                self.traced(x, env) for x in (node.test, node.body, node.orelse)
+            )
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            inner = set(env)
+            for gen in node.generators:
+                if self.traced(gen.iter, env):
+                    inner |= _target_names(gen.target)
+            return self.traced(node.elt, inner)
+        if isinstance(node, ast.Slice):
+            return any(
+                self.traced(x, env)
+                for x in (node.lower, node.upper, node.step)
+                if x is not None
+            )
+        return False
+
+    def _call_traced(self, node: ast.Call, env: set[str]) -> bool:
+        if isinstance(node.func, ast.Name):
+            if node.func.id in _CONVERSIONS or node.func.id in _HOST_BUILTINS:
+                return False
+        dotted = self.index.resolve_dotted(self.fi, node.func)
+        if dotted is not None and (
+            dotted == "jax.device_get" or dotted.endswith(".device_get")
+        ):
+            return False
+        args_traced = any(self.traced(a, env) for a in node.args) or any(
+            self.traced(kw.value, env) for kw in node.keywords
+        )
+        # A call on a traced callable (e.g. a partial over traced operands)
+        # yields a traced value even with no traced args.
+        return args_traced or self.traced(node.func, env)
+
+    def branch_traced(self, node: ast.AST, env: set[str]) -> bool:
+        """Tracedness of a branch test, with trace-time-concrete exemptions."""
+        if isinstance(node, ast.Compare):
+            # `x is None` / `x is not None` are concrete under trace.
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops) and all(
+                isinstance(c, ast.Constant) and c.value is None
+                for c in node.comparators
+            ):
+                return False
+        if isinstance(node, ast.BoolOp):
+            return any(self.branch_traced(v, env) for v in node.values)
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+            return self.branch_traced(node.operand, env)
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+            # bool(x)/int(x) in a test is reported as jit-host-sync already.
+            if node.func.id in _CONVERSIONS:
+                return False
+        return self.traced(node, env)
+
+    # -- body walk --------------------------------------------------------
+    def walk_body(self, body: list[ast.stmt], env: set[str]) -> None:
+        for stmt in body:
+            self._stmt(stmt, env)
+
+    def _stmt(self, stmt: ast.stmt, env: set[str]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            inner = set(env) | set(_param_names(stmt))
+            inner.add(stmt.name)
+            env.add(stmt.name)  # calls to it yield traced values
+            self.walk_body(stmt.body, inner)
+            return
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._expr(stmt.value, env)
+            return
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            if value is not None:
+                self._expr(value, env)
+            targets = (
+                stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            )
+            if isinstance(stmt, ast.AugAssign):
+                flag = value is not None and (
+                    self.traced(value, env) or self.traced(stmt.target, env)
+                )
+            else:
+                flag = value is not None and self.traced(value, env)
+            for tgt in targets:
+                self._bind(tgt, value, flag, env)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._expr(stmt.test, env)
+            if self.branch_traced(stmt.test, env):
+                kind = "if" if isinstance(stmt, ast.If) else "while"
+                self.reporter.emit(
+                    self.sf,
+                    "jit-tracer-branch",
+                    stmt,
+                    f"`{kind}` on a tracer-derived value "
+                    f"({ast.unparse(stmt.test)[:60]}); use lax.cond/select or "
+                    "hoist the decision out of the jitted function",
+                )
+            self.walk_body(stmt.body, env)
+            self.walk_body(stmt.orelse, env)
+            return
+        if isinstance(stmt, ast.Assert):
+            self._expr(stmt.test, env)
+            if self.branch_traced(stmt.test, env):
+                self.reporter.emit(
+                    self.sf,
+                    "jit-tracer-branch",
+                    stmt,
+                    "`assert` on a tracer-derived value; use "
+                    "checkify or move the check to the host",
+                )
+            return
+        if isinstance(stmt, ast.For):
+            self._expr(stmt.iter, env)
+            if self.traced(stmt.iter, env):
+                for name in _target_names(stmt.target):
+                    env.add(name)
+            self.walk_body(stmt.body, env)
+            self.walk_body(stmt.orelse, env)
+            return
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self._expr(item.context_expr, env)
+            self.walk_body(stmt.body, env)
+            return
+        if isinstance(stmt, ast.Try):
+            self.walk_body(stmt.body, env)
+            for h in stmt.handlers:
+                self.walk_body(h.body, env)
+            self.walk_body(stmt.orelse, env)
+            self.walk_body(stmt.finalbody, env)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._expr(stmt.value, env)
+            return
+        # pass/break/continue/raise/global/etc.: nothing traced to track
+        if isinstance(stmt, ast.Raise) and stmt.exc is not None:
+            self._expr(stmt.exc, env)
+
+    def _bind(
+        self, tgt: ast.AST, value: ast.AST | None, flag: bool, env: set[str]
+    ) -> None:
+        if isinstance(tgt, ast.Name):
+            if flag:
+                env.add(tgt.id)
+            else:
+                env.discard(tgt.id)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            if isinstance(value, (ast.Tuple, ast.List)) and len(value.elts) == len(
+                tgt.elts
+            ):
+                for t, v in zip(tgt.elts, value.elts):
+                    self._bind(t, v, self.traced(v, env), env)
+            else:
+                for t in tgt.elts:
+                    self._bind(t, None, flag, env)
+        # attribute/subscript targets: no name to track
+
+    # -- expression checks ------------------------------------------------
+    def _expr(self, node: ast.AST, env: set[str]) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Lambda):
+                inner = set(env) | set(_param_names(sub))
+                self._expr(sub.body, inner)
+            elif isinstance(sub, ast.IfExp):
+                if self.branch_traced(sub.test, env):
+                    self.reporter.emit(
+                        self.sf,
+                        "jit-tracer-branch",
+                        sub,
+                        "conditional expression on a tracer-derived value; "
+                        "use jnp.where / lax.select",
+                    )
+            elif isinstance(sub, ast.Call):
+                self._check_call(sub, env)
+
+    def _check_call(self, node: ast.Call, env: set[str]) -> None:
+        args_traced = any(self.traced(a, env) for a in node.args) or any(
+            self.traced(kw.value, env) for kw in node.keywords
+        )
+        if isinstance(node.func, ast.Name):
+            fname = node.func.id
+            if fname in _CONVERSIONS and args_traced:
+                self.reporter.emit(
+                    self.sf,
+                    "jit-host-sync",
+                    node,
+                    f"{fname}() on a traced value fails under jit "
+                    "(concretization of a tracer); compute on-device or "
+                    "return the value and convert on the host",
+                )
+                return
+            if fname == "print":
+                self.reporter.emit(
+                    self.sf,
+                    "jit-host-sync",
+                    node,
+                    "print inside traced code runs at trace time only; "
+                    "use jax.debug.print",
+                )
+                return
+        dotted = self.index.resolve_dotted(self.fi, node.func)
+        if dotted in _NUMPY_SYNCS and args_traced:
+            self.reporter.emit(
+                self.sf,
+                "jit-host-sync",
+                node,
+                f"{dotted.replace('numpy', 'np')} on a traced value forces a "
+                "host transfer and fails under jit; use jnp instead",
+            )
+            return
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _SYNC_METHODS
+            and self.traced(node.func.value, env)
+        ):
+            self.reporter.emit(
+                self.sf,
+                "jit-host-sync",
+                node,
+                f".{node.func.attr}() on a traced value syncs/fails under jit",
+            )
+            return
+        # propagate into analyzed callees receiving traced arguments
+        rec = self.index.resolve_func(self.fi, node.func)
+        if rec is not None and not rec.is_jitted and args_traced:
+            traced_params: set[str] = set()
+            for i, a in enumerate(node.args):
+                if i < len(rec.params) and self.traced(a, env):
+                    traced_params.add(rec.params[i])
+            for kw in node.keywords:
+                if kw.arg in rec.params and self.traced(kw.value, env):
+                    traced_params.add(kw.arg)
+            if traced_params:
+                self.enqueue(rec, frozenset(traced_params))
+
+
+# ---------------------------------------------------------------------------
+# Static-arg hygiene
+# ---------------------------------------------------------------------------
+
+_NON_HASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.SetComp, ast.DictComp)
+
+
+def _check_static_hygiene(index: _Index, reporter: Reporter, fi: FileIndex) -> None:
+    for rec in fi.funcs.values():
+        if not rec.is_jitted or rec.jit_site is None:
+            continue
+        site = rec.jit_site
+        declared: list[str] = []
+        nums: list[int] = []
+        if isinstance(site, ast.Call):
+            for kw in site.keywords:
+                if kw.arg == "static_argnames":
+                    declared = _const_strs(kw.value)
+                elif kw.arg == "static_argnums":
+                    nums = _const_ints(kw.value)
+        for name in declared:
+            if name not in rec.params:
+                reporter.emit(
+                    fi.sf,
+                    "jit-static-hygiene",
+                    site,
+                    f"static_argnames names '{name}' which is not a parameter "
+                    f"of {rec.name}()",
+                )
+        for idx in nums:
+            if not (0 <= idx < len(rec.params)):
+                reporter.emit(
+                    fi.sf,
+                    "jit-static-hygiene",
+                    site,
+                    f"static_argnums index {idx} is out of range for "
+                    f"{rec.name}() with {len(rec.params)} parameters",
+                )
+        # non-hashable defaults on static params recompile on every call
+        a = rec.node.args
+        pos = a.posonlyargs + a.args
+        for p, d in zip(pos[len(pos) - len(a.defaults) :], a.defaults):
+            if p.arg in rec.static_names and isinstance(d, _NON_HASHABLE):
+                reporter.emit(
+                    fi.sf,
+                    "jit-static-hygiene",
+                    d,
+                    f"static parameter '{p.arg}' of {rec.name}() has a "
+                    "non-hashable default; jit static args must be hashable",
+                )
+        for p, d in zip(a.kwonlyargs, a.kw_defaults):
+            if d is not None and p.arg in rec.static_names and isinstance(
+                d, _NON_HASHABLE
+            ):
+                reporter.emit(
+                    fi.sf,
+                    "jit-static-hygiene",
+                    d,
+                    f"static parameter '{p.arg}' of {rec.name}() has a "
+                    "non-hashable default; jit static args must be hashable",
+                )
+    # call sites passing non-hashable literals into static slots
+    for node in ast.walk(fi.sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        rec = index.resolve_func(fi, node.func)
+        if rec is None or not rec.is_jitted or not rec.static_names:
+            continue
+        for i, arg in enumerate(node.args):
+            if i < len(rec.params) and rec.params[i] in rec.static_names:
+                if isinstance(arg, _NON_HASHABLE):
+                    reporter.emit(
+                        fi.sf,
+                        "jit-static-hygiene",
+                        arg,
+                        f"non-hashable literal passed to static parameter "
+                        f"'{rec.params[i]}' of {rec.name}(); every call "
+                        "re-traces — pass a tuple or hoist to a constant",
+                    )
+        for kw in node.keywords:
+            if kw.arg in rec.static_names and isinstance(kw.value, _NON_HASHABLE):
+                reporter.emit(
+                    fi.sf,
+                    "jit-static-hygiene",
+                    kw.value,
+                    f"non-hashable literal passed to static parameter "
+                    f"'{kw.arg}' of {rec.name}(); every call re-traces — "
+                    "pass a tuple or hoist to a constant",
+                )
+
+
+# ---------------------------------------------------------------------------
+# Host-dispatch sync analysis (jit-dispatch-sync)
+# ---------------------------------------------------------------------------
+
+
+class _DispatchWalker:
+    """Track device-derived (DD) values through host dispatch code."""
+
+    def __init__(self, index: _Index, fi: FileIndex, reporter: Reporter) -> None:
+        self.index = index
+        self.fi = fi
+        self.reporter = reporter
+        self.sf = fi.sf
+        #: (module, func) -> returns-device-derived
+        self.ret_dd = _RET_DD_CACHE
+
+    # -- DD classification -------------------------------------------------
+    def dd(self, node: ast.AST, env: set[str]) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in env
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            if (
+                isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and ("self." + node.attr) in env
+            ):
+                return True
+            return self.dd(node.value, env)
+        if isinstance(node, ast.Subscript):
+            return self.dd(node.value, env)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.dd(el, env) for el in node.elts)
+        if isinstance(node, ast.Call):
+            return self.call_dd(node, env)
+        if isinstance(node, ast.BinOp):
+            return self.dd(node.left, env) or self.dd(node.right, env)
+        if isinstance(node, ast.UnaryOp):
+            return self.dd(node.operand, env)
+        if isinstance(node, ast.Compare):
+            return self.dd(node.left, env) or any(
+                self.dd(c, env) for c in node.comparators
+            )
+        if isinstance(node, ast.BoolOp):
+            return any(self.dd(v, env) for v in node.values)
+        if isinstance(node, ast.IfExp):
+            return self.dd(node.body, env) or self.dd(node.orelse, env)
+        return False
+
+    def call_dd(self, node: ast.Call, env: set[str]) -> bool:
+        if isinstance(node.func, ast.Name):
+            if node.func.id in _CONVERSIONS or node.func.id in _HOST_BUILTINS:
+                return False
+            if node.func.id in env and node.func.id.startswith("__local_fn_"):
+                return True
+        dotted = self.index.resolve_dotted(self.fi, node.func)
+        if dotted is not None:
+            if dotted in ("jax.device_get", "jax.block_until_ready"):
+                return False
+            if dotted in _NUMPY_SYNCS or dotted.startswith("numpy."):
+                return False
+            if dotted.startswith("jax.numpy.") or dotted.startswith("jax.lax."):
+                return True
+            mod, _, fname = dotted.rpartition(".")
+            rec = self.index.funcs.get((mod, fname))
+            if rec is not None:
+                if rec.is_jitted:
+                    return True
+                if self.ret_dd.get(rec.key, False):
+                    return True
+        # local nested function known to return device values
+        if isinstance(node.func, ast.Name) and ("fn:" + node.func.id) in env:
+            return True
+        return False
+
+    # -- walk --------------------------------------------------------------
+    def walk_function(
+        self, node: ast.FunctionDef | ast.AsyncFunctionDef, env: set[str]
+    ) -> None:
+        self.walk_body(node.body, env)
+
+    def walk_body(self, body: list[ast.stmt], env: set[str]) -> None:
+        for stmt in body:
+            self._stmt(stmt, env)
+
+    def _stmt(self, stmt: ast.stmt, env: set[str]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested dispatch helper: walk its body with the outer env and
+            # record whether it returns device-derived values
+            inner = set(env)
+            self.walk_body(stmt.body, inner)
+            if self._returns_dd(stmt, env):
+                env.add("fn:" + stmt.name)
+            return
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            if stmt.value is not None:
+                self._expr(stmt.value, env)
+            targets = (
+                stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            )
+            flag = stmt.value is not None and self.dd(stmt.value, env)
+            for tgt in targets:
+                self._bind(tgt, stmt.value, flag, env)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._expr(stmt.test, env)
+            if self._branch_dd(stmt.test, env):
+                kind = "if" if isinstance(stmt, ast.If) else "while"
+                self.reporter.emit(
+                    self.sf,
+                    "jit-dispatch-sync",
+                    stmt,
+                    f"`{kind}` on a device value blocks on the device stream "
+                    f"({ast.unparse(stmt.test)[:60]}); fetch once with "
+                    "jax.device_get and branch on the host value",
+                    severity=Severity.WARNING,
+                )
+            self.walk_body(stmt.body, env)
+            self.walk_body(stmt.orelse, env)
+            return
+        if isinstance(stmt, ast.Assert):
+            self._expr(stmt.test, env)
+            if self._branch_dd(stmt.test, env):
+                self.reporter.emit(
+                    self.sf,
+                    "jit-dispatch-sync",
+                    stmt,
+                    "`assert` on a device value forces a sync; fetch once "
+                    "with jax.device_get",
+                    severity=Severity.WARNING,
+                )
+            return
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._expr(stmt.value, env)
+            return
+        if isinstance(stmt, ast.For):
+            self._expr(stmt.iter, env)
+            if self.dd(stmt.iter, env):
+                for name in _target_names(stmt.target):
+                    env.add(name)
+            self.walk_body(stmt.body, env)
+            self.walk_body(stmt.orelse, env)
+            return
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self._expr(item.context_expr, env)
+            self.walk_body(stmt.body, env)
+            return
+        if isinstance(stmt, ast.Try):
+            self.walk_body(stmt.body, env)
+            for h in stmt.handlers:
+                self.walk_body(h.body, env)
+            self.walk_body(stmt.orelse, env)
+            self.walk_body(stmt.finalbody, env)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._expr(stmt.value, env)
+            return
+        if isinstance(stmt, ast.Raise) and stmt.exc is not None:
+            self._expr(stmt.exc, env)
+
+    def _bind(
+        self, tgt: ast.AST, value: ast.AST | None, flag: bool, env: set[str]
+    ) -> None:
+        if isinstance(tgt, ast.Name):
+            if flag:
+                env.add(tgt.id)
+            else:
+                env.discard(tgt.id)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            if isinstance(value, (ast.Tuple, ast.List)) and len(value.elts) == len(
+                tgt.elts
+            ):
+                for t, v in zip(tgt.elts, value.elts):
+                    self._bind(t, v, self.dd(v, env), env)
+            else:
+                for t in tgt.elts:
+                    self._bind(t, None, flag, env)
+        elif (
+            isinstance(tgt, ast.Attribute)
+            and isinstance(tgt.value, ast.Name)
+            and tgt.value.id == "self"
+        ):
+            if flag:
+                env.add("self." + tgt.attr)
+
+    def _returns_dd(
+        self, node: ast.FunctionDef | ast.AsyncFunctionDef, env: set[str]
+    ) -> bool:
+        inner = set(env)
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Return) and sub.value is not None:
+                if self.dd(sub.value, inner):
+                    return True
+        return False
+
+    def _branch_dd(self, node: ast.AST, env: set[str]) -> bool:
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops) and all(
+                isinstance(c, ast.Constant) and c.value is None
+                for c in node.comparators
+            ):
+                return False
+        if isinstance(node, ast.BoolOp):
+            return any(self._branch_dd(v, env) for v in node.values)
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+            return self._branch_dd(node.operand, env)
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+            if node.func.id in _CONVERSIONS:
+                return False  # the conversion itself is flagged
+        return self.dd(node, env)
+
+    def _expr(self, node: ast.AST, env: set[str]) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Lambda):
+                self._lambda(sub, env)
+            elif isinstance(sub, ast.Call):
+                self._check_call(sub, env)
+
+    def _lambda(self, node: ast.Lambda, env: set[str]) -> None:
+        inner = set(env) - set(_param_names(node))
+        for sub in ast.walk(node.body):
+            if isinstance(sub, ast.Call):
+                self._check_call(sub, inner)
+
+    def _check_call(self, node: ast.Call, env: set[str]) -> None:
+        args_dd = any(self.dd(a, env) for a in node.args)
+        if isinstance(node.func, ast.Name) and node.func.id in _CONVERSIONS and args_dd:
+            self.reporter.emit(
+                self.sf,
+                "jit-dispatch-sync",
+                node,
+                f"{node.func.id}() on a device value is an implicit sync; "
+                "batch fetches through a single jax.device_get",
+                severity=Severity.WARNING,
+            )
+            return
+        dotted = self.index.resolve_dotted(self.fi, node.func)
+        if dotted in _NUMPY_SYNCS and args_dd:
+            self.reporter.emit(
+                self.sf,
+                "jit-dispatch-sync",
+                node,
+                f"{dotted.replace('numpy', 'np')} on a device value is an "
+                "implicit sync; batch fetches through a single jax.device_get",
+                severity=Severity.WARNING,
+            )
+
+
+_RET_DD_CACHE: dict[tuple[str, str], bool] = {}
+
+
+def _compute_ret_dd(index: _Index, scope: list[FileIndex]) -> None:
+    """Fixpoint: which module-level functions return device-derived values."""
+    _RET_DD_CACHE.clear()
+    changed = True
+    while changed:
+        changed = False
+        for fi in scope:
+            walker = _DispatchWalker(index, fi, _NullReporter())
+            for rec in fi.funcs.values():
+                if rec.is_jitted or _RET_DD_CACHE.get(rec.key, False):
+                    continue
+                # simulate the body to build a local DD env, then test returns
+                env: set[str] = set()
+                try:
+                    walker.walk_body_silent(rec.node.body, env)
+                except RecursionError:  # pragma: no cover - defensive
+                    continue
+                for sub in ast.walk(rec.node):
+                    if isinstance(sub, ast.Return) and sub.value is not None:
+                        if walker.dd(sub.value, env):
+                            _RET_DD_CACHE[rec.key] = True
+                            changed = True
+                            break
+
+
+class _NullReporter:
+    def emit(self, *a, **kw) -> None:
+        pass
+
+
+def _walk_body_silent(self, body, env):
+    """Body walk that only updates the env (no findings emitted)."""
+    saved = self.reporter
+    self.reporter = _NullReporter()
+    try:
+        self.walk_body(body, env)
+    finally:
+        self.reporter = saved
+
+
+_DispatchWalker.walk_body_silent = _walk_body_silent
+
+
+def _target_names(tgt: ast.AST) -> set[str]:
+    out: set[str] = set()
+    for sub in ast.walk(tgt):
+        if isinstance(sub, ast.Name):
+            out.add(sub.id)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Entry point
+# ---------------------------------------------------------------------------
+
+
+def check(
+    files: list[SourceFile],
+    reporter: Reporter,
+    config: AnalysisConfig,
+    root: Path,
+) -> None:
+    scope_files = [sf for sf in files if _in_jit_paths(sf.rel, config)]
+    if not scope_files:
+        return
+    index = _Index(scope_files)
+    scope = [index.by_module[_module_name(sf.rel)] for sf in scope_files]
+
+    # R3: static-arg hygiene at decoration and call sites
+    for fi in scope:
+        _check_static_hygiene(index, reporter, fi)
+
+    # R1/R2: traced-context fixpoint from the jitted roots
+    seen: set[tuple[tuple[str, str], frozenset[str]]] = set()
+    queue: list[tuple[FuncRecord, frozenset[str]]] = []
+
+    def enqueue(rec: FuncRecord, traced: frozenset[str]) -> None:
+        key = (rec.key, traced)
+        if key not in seen and _in_jit_paths(rec.sf.rel, config):
+            seen.add(key)
+            queue.append((rec, traced))
+
+    for fi in scope:
+        for rec in fi.funcs.values():
+            if rec.is_jitted:
+                traced = frozenset(set(rec.params) - rec.static_names)
+                enqueue(rec, traced)
+    while queue:
+        rec, traced = queue.pop()
+        fi = index.by_module[rec.module]
+        walker = _TracedWalker(index, fi, reporter, enqueue)
+        walker.walk_body(rec.node.body, set(traced))
+
+    # R4: host dispatch syncs
+    traced_fn_keys = {k for (k, _t) in seen}
+    _compute_ret_dd(index, scope)
+    for fi in scope:
+        walker = _DispatchWalker(index, fi, reporter)
+        for node in fi.sf.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                rec = fi.funcs.get(node.name)
+                if rec is not None and (rec.is_jitted or rec.key in traced_fn_keys):
+                    continue
+                walker.walk_function(node, set())
+            elif isinstance(node, ast.ClassDef):
+                # two passes: first learn which self attrs hold device values
+                self_dd: set[str] = set()
+                for meth in node.body:
+                    if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        env: set[str] = set()
+                        walker.walk_body_silent(meth.body, env)
+                        self_dd |= {n for n in env if n.startswith("self.")}
+                for meth in node.body:
+                    if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        walker.walk_function(meth, set(self_dd))

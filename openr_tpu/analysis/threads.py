@@ -1,0 +1,198 @@
+"""Thread-discipline checkers.
+
+Open/R's module invariant: each module (an ``OpenrEventBase`` subclass)
+owns its state on its own thread + asyncio loop; modules communicate only
+through ``RWQueue`` / ``ReplicateQueue`` streams or the ctrl handler's
+``run_in_event_base_thread`` RPC seam.  Two rules enforce the static part:
+
+- ``thread-cross-module-write``: an attribute *write* whose base is a
+  module handle (``self.kvstore.x = ...`` or a local named after a module
+  handle) from code outside that module's own class.  Reads are allowed —
+  plenty of code inspects counters — but a write from another thread races
+  the owner loop.  Composition-root wiring (performed in ``main.py`` before
+  the module threads start) is expected to carry an explicit suppression.
+- ``thread-queue-registration``: every ``ReplicateQueue``/``RWQueue``
+  created on the daemon in ``main.py`` must be registered in the named
+  ``self._queues`` dict — that dict is the introspection surface
+  (``queue.<name>.*`` counters, drain-on-shutdown, chaos hooks); an
+  unregistered queue is invisible to all three.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from .core import AnalysisConfig, Reporter, SourceFile
+
+_QUEUE_CLASSES = {"ReplicateQueue", "RWQueue"}
+
+#: default module-handle attribute names (overridable via config)
+DEFAULT_MODULE_ATTRS = [
+    "kvstore",
+    "decision",
+    "fib",
+    "link_monitor",
+    "spark",
+    "monitor",
+    "prefix_manager",
+    "ctrl_server",
+    "thrift_shim",
+    "netlink",
+    "watchdog",
+]
+
+
+def _class_owns_attr(class_name: str, attr: str) -> bool:
+    """`KvStore` owns `kvstore`, `LinkMonitor` owns `link_monitor`, ..."""
+    snake = "".join(
+        ("_" + c.lower()) if c.isupper() else c for c in class_name
+    ).lstrip("_")
+    return snake == attr or class_name.lower() == attr.replace("_", "")
+
+
+def check(
+    files: list[SourceFile],
+    reporter: Reporter,
+    config: AnalysisConfig,
+    root: Path,
+) -> None:
+    module_attrs = set(config.module_attrs or DEFAULT_MODULE_ATTRS)
+    for sf in files:
+        _check_cross_module_writes(sf, reporter, module_attrs)
+        # self-gates on the presence of a `self._queues = {...}` registry
+        _check_queue_registration(sf, reporter)
+
+
+def _check_cross_module_writes(
+    sf: SourceFile, reporter: Reporter, module_attrs: set[str]
+) -> None:
+    class_stack: list[str] = []
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, ast.ClassDef):
+            class_stack.append(node.name)
+            for child in node.body:
+                visit(child)
+            class_stack.pop()
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for tgt in targets:
+                _check_target(tgt, node)
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    def _check_target(tgt: ast.AST, stmt: ast.stmt) -> None:
+        if not isinstance(tgt, ast.Attribute):
+            # `self.kvstore.counters["x"] = 1` has a Subscript target whose
+            # value chain still bottoms out in a module handle
+            if isinstance(tgt, ast.Subscript):
+                _check_target_base(tgt.value, None, stmt)
+            return
+        _check_target_base(tgt.value, tgt.attr, stmt)
+
+    def _check_target_base(
+        base: ast.AST, attr: str | None, stmt: ast.stmt
+    ) -> None:
+        # Find a module handle anywhere along the base chain, so both
+        # `self.kvstore.x = ...` and `self.kvstore.counters["x"] = ...`
+        # (a Subscript target) are caught.
+        handle: str | None = None
+        cur = base
+        while isinstance(cur, (ast.Attribute, ast.Subscript)):
+            if (
+                isinstance(cur, ast.Attribute)
+                and isinstance(cur.value, ast.Name)
+                and cur.value.id == "self"
+                and cur.attr in module_attrs
+            ):
+                handle = cur.attr
+                break
+            cur = cur.value
+        if handle is None and isinstance(cur, ast.Name) and cur.id in module_attrs:
+            handle = cur.id
+        if handle is None:
+            return
+        if class_stack and _class_owns_attr(class_stack[-1], handle):
+            return
+        what = f".{attr}" if attr else "[...]"
+        reporter.emit(
+            sf,
+            "thread-cross-module-write",
+            stmt,
+            f"write to `{handle}{what}` crosses a module-thread boundary; "
+            "modules own their state — communicate through a queue or "
+            "run_in_event_base_thread (pre-start wiring in the composition "
+            "root should carry an explicit suppression)",
+        )
+
+    visit(sf.tree)
+
+
+def _check_queue_registration(sf: SourceFile, reporter: Reporter) -> None:
+    for cls in ast.walk(sf.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        created: dict[str, ast.stmt] = {}
+        registered: set[str] = set()
+        has_registry = False
+        for node in ast.walk(cls):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign) else [node.target]
+                )
+                value = node.value
+                if value is None:
+                    continue
+                for tgt in targets:
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        if _creates_queue(value):
+                            created[tgt.attr] = node
+                        if tgt.attr == "_queues" and isinstance(value, ast.Dict):
+                            has_registry = True
+                            for v in value.values:
+                                if (
+                                    isinstance(v, ast.Attribute)
+                                    and isinstance(v.value, ast.Name)
+                                    and v.value.id == "self"
+                                ):
+                                    registered.add(v.attr)
+        if not has_registry:
+            continue
+        for attr, node in sorted(created.items()):
+            if attr not in registered:
+                reporter.emit(
+                    sf,
+                    "thread-queue-registration",
+                    node,
+                    f"queue `self.{attr}` is not registered in the named "
+                    "`self._queues` dict; unregistered queues are invisible "
+                    "to queue.<name>.* counters, shutdown drain, and chaos "
+                    "hooks",
+                )
+
+
+def _creates_queue(value: ast.AST) -> bool:
+    """True for `ReplicateQueue(...)` and `injected or ReplicateQueue(...)`."""
+    if isinstance(value, ast.BoolOp):
+        return any(_creates_queue(v) for v in value.values)
+    return (
+        isinstance(value, ast.Call)
+        and _call_class_name(value) in _QUEUE_CLASSES
+    )
+
+
+def _call_class_name(call: ast.Call) -> str | None:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None

@@ -49,6 +49,7 @@ class OpenrCtrlHandler:
         prefix_manager=None,
         spark=None,
         monitor=None,
+        netlink=None,
         config=None,
         kvstore_updates_queue: Optional[ReplicateQueue[Publication]] = None,
         fib_updates_queue: Optional[ReplicateQueue] = None,
@@ -67,6 +68,7 @@ class OpenrCtrlHandler:
         self.prefix_manager = prefix_manager
         self.spark = spark
         self.monitor = monitor
+        self.netlink = netlink
         self.config = config
         self.kvstore_updates_queue = kvstore_updates_queue
         self.fib_updates_queue = fib_updates_queue
@@ -282,6 +284,7 @@ class OpenrCtrlHandler:
             self.prefix_manager,
             self.spark,
             self.monitor,
+            self.netlink,
         ):
             if module is None:
                 continue

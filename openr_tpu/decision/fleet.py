@@ -187,7 +187,11 @@ def _affected_init(prev: "FleetRouteView", new: "FleetRouteView"):
         small_dist=bool(small),
         max_iters=128,
     )
-    if not bool(done):
+    # explicit single-scalar fetch: the certification verdict decides
+    # warm-start vs cold rebuild on the host
+    import jax
+
+    if not jax.device_get(done):
         return None
     inf = jnp.uint16(INF16) if small else jnp.int32(INF32)
     return jnp.where(aff, inf, prev._dist_dev[: runner.bg.n_nodes])
@@ -350,7 +354,11 @@ class FleetRouteView:
             init_dist=init,
             maps=maps,
         )
-        if not bool(ok) and init is not None:
+        # `ok` is a host bool by reduced_all_sources' contract (fetched
+        # inside, fused with the block-counter read); the checker cannot
+        # see through the tuple return
+        # openr: disable=jit-dispatch-sync
+        if not ok and init is not None:
             # the warm relax exhausted its block budget without the
             # on-device certificate: the seed bought nothing — pay the
             # cold run rather than serve an uncertified product
@@ -367,7 +375,8 @@ class FleetRouteView:
                 self.csr.node_overloaded,
                 maps=maps,
             )
-        assert bool(ok), "fleet reverse SSSP did not reach its fixed point"
+        # host bool per the same contract  # openr: disable=jit-dispatch-sync
+        assert ok, "fleet reverse SSSP did not reach its fixed point"
         self._dist_dev = dist
         self._bitmap_dev = bitmap
         self.converged = True
@@ -387,15 +396,18 @@ class FleetRouteView:
         """dist(node -> every dest), [P] int32; fetched lazily and cached
         (one device row fetch per new node — a ctrl query touches only
         the queried router and its neighbors)."""
+        import jax
+
         i = self._node_id[node]
         hit = self._rows.get(i)
         if hit is None:
-            hit = _row_i32(np.asarray(self._dist_dev[i]))
+            hit = _row_i32(jax.device_get(self._dist_dev[i]))
             self._rows[i] = hit
         return hit
 
     def prefetch_rows(self, nodes: list[str]) -> None:
         """Fetch many routers' rows in one device gather (fleet dumps)."""
+        import jax
         import jax.numpy as jnp
 
         ids = [self._node_id[n] for n in nodes if n in self._node_id]
@@ -403,7 +415,7 @@ class FleetRouteView:
         if not missing:
             return
         rows = _row_i32(
-            np.asarray(
+            jax.device_get(
                 jnp.take(
                     self._dist_dev, jnp.asarray(missing, jnp.int32), axis=0
                 )
@@ -428,9 +440,11 @@ class FleetRouteView:
         neighbors of `node` toward `dest` (unique neighbors; parallel
         links share a slot).  Used by tests/dumps to cross-check the
         host-side per-link evaluation."""
+        import jax
+
         i = self._node_id[node]
         p = self.p_index[dest]
-        words = np.asarray(self._bitmap_dev[i, p])
+        words = jax.device_get(self._bitmap_dev[i, p])
         slot_names = self.csr.slot_neighbors(node)
         out: set[str] = set()
         for w in range(words.shape[0]):

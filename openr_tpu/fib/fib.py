@@ -70,6 +70,9 @@ class MockFibAgent:
         # seeded per-call failure/restart schedule (chaos.FibChaosPlan
         # duck type: on_call(op) -> "ok" | "fail" | "restart")
         self.chaos = None
+        # Bare keys are the mock's public test surface (asserted as
+        # agent.counters["sync_fib"] etc.); the daemon-side dump exports
+        # them convention-clean as fib.agent.<key> via Fib.get_counters.
         self.counters = {
             "add_unicast": 0,
             "del_unicast": 0,
@@ -106,7 +109,7 @@ class MockFibAgent:
             table = self.unicast.setdefault(client_id, {})
             for route in routes:
                 table[route.dest] = route
-            self.counters["add_unicast"] += len(routes)
+            self.counters["add_unicast"] += len(routes)  # openr: disable=counter-name
 
     def delete_unicast_routes(self, client_id: int, prefixes: list[str]) -> None:
         self._check("delete_unicast_routes")
@@ -114,7 +117,7 @@ class MockFibAgent:
             table = self.unicast.setdefault(client_id, {})
             for prefix in prefixes:
                 table.pop(prefix, None)
-            self.counters["del_unicast"] += len(prefixes)
+            self.counters["del_unicast"] += len(prefixes)  # openr: disable=counter-name
 
     def add_mpls_routes(self, client_id: int, routes: list[MplsRoute]) -> None:
         self._check("add_mpls_routes")
@@ -122,7 +125,7 @@ class MockFibAgent:
             table = self.mpls.setdefault(client_id, {})
             for route in routes:
                 table[route.top_label] = route
-            self.counters["add_mpls"] += len(routes)
+            self.counters["add_mpls"] += len(routes)  # openr: disable=counter-name
 
     def delete_mpls_routes(self, client_id: int, labels: list[int]) -> None:
         self._check("delete_mpls_routes")
@@ -130,19 +133,19 @@ class MockFibAgent:
             table = self.mpls.setdefault(client_id, {})
             for label in labels:
                 table.pop(label, None)
-            self.counters["del_mpls"] += len(labels)
+            self.counters["del_mpls"] += len(labels)  # openr: disable=counter-name
 
     def sync_fib(self, client_id: int, routes: list[UnicastRoute]) -> None:
         self._check("sync_fib")
         with self._lock:
             self.unicast[client_id] = {r.dest: r for r in routes}
-            self.counters["sync_fib"] += 1
+            self.counters["sync_fib"] += 1  # openr: disable=counter-name
 
     def sync_mpls_fib(self, client_id: int, routes: list[MplsRoute]) -> None:
         self._check("sync_mpls_fib")
         with self._lock:
             self.mpls[client_id] = {r.top_label: r for r in routes}
-            self.counters["sync_mpls"] += 1
+            self.counters["sync_mpls"] += 1  # openr: disable=counter-name
 
     def get_route_table_by_client(self, client_id: int) -> list[UnicastRoute]:
         with self._lock:
@@ -224,6 +227,18 @@ class Fib(OpenrEventBase):
 
     def _bump(self, counter: str, n: int = 1) -> None:
         self.counters[counter] = self.counters.get(counter, 0) + n
+
+    def get_counters(self) -> dict[str, int]:
+        """Own counters plus the in-process agent's programming counters
+        namespaced as fib.agent.<key>, so the ctrl dump covers the whole
+        programming path even when the agent is the in-process mock."""
+        out = dict(self.counters)
+        agent_counters = getattr(self.agent, "counters", None)
+        if isinstance(agent_counters, dict):
+            for key, val in agent_counters.items():
+                if isinstance(key, str) and isinstance(val, int):
+                    out[f"fib.agent.{key}"] = val
+        return out
 
     # -- lifecycle -----------------------------------------------------------
 

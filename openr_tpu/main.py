@@ -94,6 +94,9 @@ class OpenrDaemon:
             "static_routes": self.static_routes_queue,
             "fib_updates": self.fib_updates_queue,
             "log_sample": self.log_sample_queue,
+            # found by thread-queue-registration: the netlink event stream
+            # was invisible to queue.* counters and the shutdown drain
+            "netlink_events": self.netlink_events_queue,
         }
 
         # -- watchdog (reference: Main.cpp:295-300) --------------------------
@@ -251,6 +254,9 @@ class OpenrDaemon:
             self.kvstore,
             self.kvstore_updates_queue.get_reader(),
         )
+        # composition-root wiring: single startup assignment, read only by
+        # work scheduled onto the link-monitor loop after this point
+        # openr: disable=thread-cross-module-write
         self.link_monitor.kvstore_client = self.kvstore_client
 
         self.prefix_manager = PrefixManager(
@@ -314,6 +320,7 @@ class OpenrDaemon:
             prefix_manager=self.prefix_manager,
             spark=self.spark,
             monitor=self.monitor,
+            netlink=self.netlink,
             config=self.config,
             kvstore_updates_queue=self.kvstore_updates_queue,
             fib_updates_queue=self.fib_updates_queue,

@@ -490,17 +490,23 @@ def reduced_all_sources(
 
         small = reverse_runner.small_dist
         dist, bitmap, ok, blocks = run_prog(small)
-        if small and not bool(ok):
+        # One explicit fetch for the convergence certificate + block count:
+        # the retry/hint decisions below are host control flow, and reading
+        # the two scalars piecemeal (bool(ok), bool(ok), int(blocks)) would
+        # block the dispatch thread up to three times per round.
+        ok_h, blocks_h = jax.device_get((ok, blocks))
+        if small and not ok_h:
             # saturation presents as non-convergence: latch uint16 off
             # (the SpfRunner.adapt discipline) and retry once in int32
             reverse_runner.small_allowed = False
             dist, bitmap, ok, blocks = run_prog(False)
-        if bool(ok) and init_dist is None:
+            ok_h, blocks_h = jax.device_get((ok, blocks))
+        if ok_h and init_dist is None:
             # teach the fixed-sweep hint from the cold progressive run
             # (warm runs converge in delta-sized counts — not a valid
             # cold budget, so they never write it)
-            reverse_runner.hint = max(1, int(blocks) * check_every)
-        return dist, bitmap, ok
+            reverse_runner.hint = max(1, int(blocks_h) * check_every)
+        return dist, bitmap, bool(ok_h)
 
     def run(sweeps: int, want_bitmap: bool):
         # the one-program fusion exists on the banded path only; the ELL
@@ -535,11 +541,15 @@ def reduced_all_sources(
         # capped refine-down): SpfRunner.adapt
         def attempt(sweeps: int):
             r = run(sweeps, want_bitmap=True)
+            # adapt() decides double/refine from the convergence verdict;
+            # one scalar sync per attempt is the price of adaptive sweep
+            # control  # openr: disable=jit-dispatch-sync
             return r, bool(r[2])
 
         dist, bitmap, ok = reverse_runner.adapt(
             "hint",
             attempt=attempt,
+            # same adaptive-control verdict  # openr: disable=jit-dispatch-sync
             probe=lambda s: bool(run(s, want_bitmap=False)[2]),
             eff_small=lambda: reverse_runner.small_dist,
         )
@@ -547,7 +557,12 @@ def reduced_all_sources(
         bitmap = ecmp_bitmap_from_reverse_dist(
             dist, out, edge_metric, edge_up, node_overloaded, out.n_words
         )
-    return dist, bitmap, ok
+    # Contract: the certificate is a HOST bool on every return path (the
+    # fused path above fetches it with device_get), so callers can branch
+    # on it without paying another sync.  On the adaptive path the scalar
+    # was already realized by attempt(); this bool() is a cached read.
+    # openr: disable=jit-dispatch-sync
+    return dist, bitmap, bool(ok)
 
 
 @functools.partial(

@@ -228,14 +228,14 @@ class FusedKsp2Runner:
         self.planes_small = all(
             pick_small_dist(m, n_edges) for m in self.planes_np
         )
-        self.in_start = jnp.asarray(
-            build_in_start(np.asarray(topo_edge_dst), n_edges, n_nodes)
-        )
+        in_start_np = build_in_start(np.asarray(topo_edge_dst), n_edges, n_nodes)
+        self.in_start = jnp.asarray(in_start_np)
         rev_full = np.full(e_cap, -1, dtype=np.int32)
         rev_full[: len(rev_eid)] = rev_eid
         self.rev_eid = jnp.asarray(rev_full)
         self.rev_eid_np = rev_full
-        in_deg = np.diff(np.asarray(self.in_start))
+        # degree read stays on the host copy — no device round-trip at setup
+        in_deg = np.diff(in_start_np)
         self.k_in = max(1, int(in_deg.max()))
         # hop bound for the trace loop; grows adaptively when a converged
         # base leaves walkers short (run()), so later non-adaptive calls

@@ -168,7 +168,10 @@ class RWQueue(Generic[T]):
                 "size": len(self._items),
                 "num_pushed": self._num_pushed,
                 "num_read": self._num_read,
-                "num_overflows": self._num_overflows,
+                # canonical overflow spelling is `overflows` (matches the
+                # exported queue.<name>.overflows counter; counter-duplicate
+                # rule keeps the two stats surfaces from diverging again)
+                "overflows": self._num_overflows,
             }
 
 
@@ -231,7 +234,7 @@ class ReplicateQueue(Generic[T]):
         for q in readers:
             st = q.stats()
             depth = max(depth, st["size"])
-            overflows += st["num_overflows"]
+            overflows += st["overflows"]
         return {
             "depth": depth,
             "writes": writes,

@@ -1,0 +1,18 @@
+#!/usr/bin/env python3
+"""Run the openr-tpu static invariant checker (see docs/ARCHITECTURE.md).
+
+Equivalent to ``python -m openr_tpu.analysis openr_tpu/`` from the repo
+root, but runnable from anywhere in the tree.
+"""
+
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from openr_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    argv = sys.argv[1:] or [str(REPO_ROOT / "openr_tpu")]
+    sys.exit(main(argv))

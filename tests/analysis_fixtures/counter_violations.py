@@ -1,0 +1,34 @@
+"""Seeded counter-hygiene violations for the analyzer self-tests.
+
+Parsed only, never imported.  The export surface is injected by the test
+via counter_extra_prefixes = ["kvstore", "fib", "queue"], standing in for
+a parsed OpenrCtrlHandler._all_counters.  Line numbers are asserted
+exactly in tests/test_analysis.py.
+"""
+
+
+class Module:
+    def __init__(self):
+        self.counters = {}
+
+    def _bump(self, counter, n=1):
+        self.counters[counter] = self.counters.get(counter, 0) + n
+
+    def good(self):
+        self._bump("kvstore.sent_publications")  # clean
+        self.counters["fib.loop_runs"] = 1  # clean
+
+    def bad_name(self):
+        self._bump("SentPublications")  # line 22: counter-name
+
+    def bad_registry(self):
+        self._bump("ghost.module_counter")  # line 25: counter-registry
+
+    def duplicate_a(self):
+        self._bump("kvstore.num_updates")  # line 28: counter-duplicate
+
+    def duplicate_b(self):
+        self.counters["kvstore.updates"] = 1  # line 31: counter-duplicate
+
+    def suppressed(self):
+        self._bump("legacy_flat_counter")  # openr: disable=counter-name

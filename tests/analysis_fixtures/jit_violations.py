@@ -1,0 +1,98 @@
+"""Seeded jit-hygiene violations for the analyzer self-tests.
+
+This file is parsed by openr_tpu.analysis, never imported or executed.
+Line numbers are asserted exactly in tests/test_analysis.py — keep edits
+append-only or renumber the expectations.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def host_sync_in_trace(x):
+    y = jnp.cumsum(x)
+    total = float(y[-1])  # line 18: jit-host-sync (float on traced)
+    arr = np.asarray(y)  # line 19: jit-host-sync (np.asarray on traced)
+    print(y)  # line 20: jit-host-sync (trace-time print)
+    y.block_until_ready()  # line 21: jit-host-sync (sync method)
+    return total + arr.sum()
+
+
+@jax.jit
+def tracer_branch(x):
+    s = jnp.sum(x)
+    if s > 0:  # line 28: jit-tracer-branch
+        return s
+    while s < 0:  # line 30: jit-tracer-branch
+        s = s + 1
+    return -s
+
+
+@functools.partial(jax.jit, static_argnames=("flag",))
+def static_ok_branch(x, flag):
+    # clean: branching on a static arg is concrete at trace time
+    if flag:
+        return x + 1
+    return x - 1
+
+
+@functools.partial(jax.jit, static_argnames=("missing",))
+def bad_static_name(x):  # line 43: jit-static-hygiene (flagged at decorator)
+    return x
+
+
+@functools.partial(jax.jit, static_argnames=("shape",))
+def takes_shape(x, shape=[4, 4]):  # line 49: jit-static-hygiene (mutable default)
+    return x.reshape(tuple(shape))
+
+
+def helper_reached_from_jit(v):
+    # traced via the call in jitted_caller below
+    if v.sum() > 0:  # line 55: jit-tracer-branch (interprocedural)
+        return v
+    return -v
+
+
+@jax.jit
+def jitted_caller(x):
+    return helper_reached_from_jit(x * 2)
+
+
+@jax.jit
+def suppressed_sync(x):
+    y = jnp.sum(x)
+    return float(y)  # deliberate fixture suppression  # openr: disable=jit-host-sync
+
+
+def dispatch_layer(x):
+    dist = jitted_caller(x)
+    if dist[0] > 0:  # line 73: jit-dispatch-sync (branch on device value)
+        return int(dist[1])  # line 74: jit-dispatch-sync (int on device value)
+    return 0
+
+
+def dispatch_explicit_fetch(x):
+    # clean: single explicit fetch, host branching on host values
+    dist = jax.device_get(jitted_caller(x))
+    if dist[0] > 0:
+        return int(dist[1])
+    return 0
+
+
+def takes_shape_callsite(x):
+    return takes_shape(x, shape=[2, 8])  # line 87: jit-static-hygiene (literal)
+
+
+@jax.jit
+def clean_kernel(x, y):
+    # clean: is-None checks, shape/dtype reads and lax control flow are fine
+    if y is not None:
+        x = x + y
+    n = x.shape[0]
+    if x.dtype == jnp.int32:
+        x = x * 2
+    return jax.lax.fori_loop(0, n, lambda i, a: a + 1, x)

@@ -1,0 +1,49 @@
+"""Seeded thread-discipline violations for the analyzer self-tests.
+
+Parsed only, never imported.  Line numbers are asserted exactly in
+tests/test_analysis.py.
+"""
+
+
+class ReplicateQueue:
+    def push(self, item):
+        return True
+
+
+class KvStore:
+    def __init__(self):
+        self.counters = {}
+        self.peers = {}
+
+
+class Daemon:
+    def __init__(self):
+        self.kvstore = KvStore()
+        self.registered_queue = ReplicateQueue()  # registered below: clean
+        self.orphan_queue = ReplicateQueue()  # line 23: thread-queue-registration
+        self._queues = {
+            "registered": self.registered_queue,
+        }
+
+    def bad_wiring(self):
+        self.kvstore.peers = {}  # line 29: thread-cross-module-write
+
+    def suppressed_wiring(self):
+        # pre-start composition wiring  # openr: disable=thread-cross-module-write
+        self.kvstore.peers = {}
+
+    def clean_read(self):
+        # reads across the seam are allowed
+        return dict(self.kvstore.counters)
+
+
+class LinkMonitor:
+    def __init__(self, kvstore):
+        self._kvstore_ref = kvstore
+
+    def deep_write(self):
+        self._kvstore_ref.peers = {}  # clean: not a recognized module handle
+
+
+def local_handle_write(link_monitor):
+    link_monitor.state = "up"  # line 49: thread-cross-module-write (local name)

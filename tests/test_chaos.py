@@ -231,7 +231,8 @@ class TestQueueCounters:
         for i in range(3):
             q.push(i)
         stats = q.stats()
-        assert stats["size"] == 2 and stats["num_overflows"] == 1
+        # `overflows` is the canonical spelling (counter-duplicate rule)
+        assert stats["size"] == 2 and stats["overflows"] == 1
         assert q.get(timeout=1) == 1  # 0 was shed, newest state retained
 
     def test_replicate_queue_stats_aggregate_readers(self):

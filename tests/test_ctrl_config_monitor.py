@@ -382,3 +382,45 @@ class TestCtrlDeltaRpcs:
             client.call("floodRestartingMsg")  # no neighbors: no-op send
         finally:
             client.close()
+
+
+class TestCounterRegistrySweep:
+    """Wire-level counterpart of the counter-registry static rule: every
+    counter family the modules bump must actually surface through one
+    getCounters RPC, and every dumped key must follow the module.name
+    convention the analyzer enforces (counter-name rule)."""
+
+    def test_full_counter_set_is_dumpable(self, daemon):
+        import re
+
+        client = CtrlClient(port=daemon.ctrl_port)
+        try:
+            # fib's sync loop runs on its own thread; wait for the first
+            # sync so the fib.* and fib.agent.* families are populated
+            assert wait_for(
+                lambda: client.call("getCounters").get(
+                    "fib.sync_fib_calls", 0
+                )
+                > 0,
+                timeout=10.0,
+            ), "fib never completed its first sync"
+            counters = client.call("getCounters")
+
+            # one representative per wired family, including the two
+            # wired in by this sweep (netlink events queue, fib agent)
+            for key in (
+                "kvstore.num_keys.0",
+                "monitor.uptime_s",
+                "queue.route_updates.writes",
+                "queue.netlink_events.writes",
+                "fib.sync_fib_calls",
+                "fib.agent.sync_fib",
+            ):
+                assert key in counters, f"{key} missing from getCounters"
+
+            # the convention the counter-name rule enforces statically
+            name_re = re.compile(r"[a-z][a-z0-9_]*(\.[a-z0-9_]+)+\Z")
+            bad = [k for k in counters if not name_re.match(k)]
+            assert not bad, f"non-conventional counter keys: {bad}"
+        finally:
+            client.close()
