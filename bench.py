@@ -1317,7 +1317,12 @@ def bench_reconvergence(
     # *distribution* (the shared tunnel's bimodal flat tax), so p50/p95
     # matter here, not just min
     host_times = ms(host, reps=host_reps)
+    engine = getattr(device.spf, "engine", None)
+    snap = dict(engine.get_counters()) if engine is not None else {}
     device_times = ms(device, reps=device_reps)
+    engine_cols = _engine_attribution(
+        engine, snap, min(host_times), device_reps
+    )
     return {
         "topology": name,
         "advertised_prefixes": advertised,
@@ -1329,12 +1334,48 @@ def bench_reconvergence(
         "device_ms_p95": round(_pctl(device_times, 95), 3),
         "device_ms_all": [round(t, 2) for t in device_times],
         "device_vs_host": round(min(host_times) / min(device_times), 2),
+        **engine_cols,
         "note": (
             "measures the FORCED device path (min_device_sources=1); the "
             "shipped default policy routes these small-batch flows to the "
             "host below the measured batch crossover "
             "(DeviceSpfBackend docstring)"
         ),
+    }
+
+
+def _engine_attribution(engine, snap, host_ms_min, reps) -> dict:
+    """device.engine.* counter deltas over the timed device reps, folded
+    into the row: how much of the device wall is engine time (staging +
+    dispatch), what was staged, and whether updates stayed incremental."""
+    if engine is None:
+        return {}
+    now = engine.get_counters()
+    delta = {k: now[k] - snap.get(k, 0) for k in now}
+    engine_ms = (
+        delta["device.engine.stage_us"] + delta["device.engine.dispatch_us"]
+    ) / 1e3 / max(reps, 1)
+    return {
+        "engine_vs_host": (
+            round(host_ms_min / engine_ms, 2) if engine_ms else None
+        ),
+        "engine_ms_per_rep": round(engine_ms, 3),
+        "bytes_staged_per_rep": delta["device.engine.bytes_staged"]
+        // max(reps, 1),
+        "engine_counters_delta": {
+            k.removeprefix("device.engine."): v
+            for k, v in delta.items()
+            if v
+            and k
+            in (
+                "device.engine.queries",
+                "device.engine.bucket_hits",
+                "device.engine.bucket_misses",
+                "device.engine.compiles",
+                "device.engine.incremental_updates",
+                "device.engine.full_restages",
+            )
+        },
     }
 
 
@@ -1448,8 +1489,18 @@ def bench_ksp2(
         return out, rdb
 
     host_times, host_rdb = ms(None, host_reps)
-    device_times, device_rdb = ms(
-        DeviceSpfBackend(min_device_nodes=64, min_device_sources=1), device_reps
+    dev_backend = DeviceSpfBackend(min_device_nodes=64, min_device_sources=1)
+    snap = (
+        dict(dev_backend.engine.get_counters())
+        if dev_backend.engine is not None
+        else {}
+    )
+    device_times, device_rdb = ms(dev_backend, device_reps)
+    # cold caches each rep -> a fresh CSR mirror each rep, so the engine
+    # restages the graph per rep; bytes_staged_per_rep records that cold
+    # staging cost (the warm rows live in bench_reconvergence)
+    engine_cols = _engine_attribution(
+        dev_backend.engine, snap, min(host_times), device_reps
     )
     assert host_rdb.unicast_routes == device_rdb.unicast_routes
     return {
@@ -1460,6 +1511,7 @@ def bench_ksp2(
         "device_ms_min": round(min(device_times), 3),
         "device_ms_all": [round(t, 2) for t in device_times],
         "device_vs_host": round(min(host_times) / min(device_times), 2),
+        **engine_cols,
         "note": (
             "measures the FORCED device path (min_device_sources=1); the "
             "shipped default policy routes these small-batch flows to the "

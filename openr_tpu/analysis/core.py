@@ -140,6 +140,10 @@ ALL_RULES: dict[str, str] = {
     "jit-tracer-branch": "Python control flow on a tracer-derived value",
     "jit-static-hygiene": "static-arg misuse that breaks caching or tracing",
     "jit-dispatch-sync": "implicit device->host sync in jit dispatch code",
+    "jit-unbucketed-dispatch": (
+        "daemon code calls a jitted kernel directly, bypassing the device "
+        "engine front-end (no shape bucketing, residency or accounting)"
+    ),
     # thread discipline (openr_tpu/analysis/threads.py)
     "thread-cross-module-write": (
         "attribute write into another module, bypassing queue/ctrl seams"
@@ -168,6 +172,12 @@ class AnalysisConfig:
     exclude: list[str] = field(default_factory=list)
     #: files/dirs whose call graphs the jit checkers analyze
     jit_paths: list[str] = field(default_factory=list)
+    #: files/dirs allowed to dispatch jitted kernels directly (the sanctioned
+    #: device-engine front-end); everything else outside jit_paths is daemon
+    #: code and must route dispatch through the engine
+    engine_dispatch_paths: list[str] = field(
+        default_factory=lambda: ["openr_tpu/device"]
+    )
     #: extra top-level counter prefixes treated as exported (beyond the ones
     #: discovered by parsing OpenrCtrlHandler._all_counters)
     counter_extra_prefixes: list[str] = field(default_factory=list)
@@ -260,6 +270,7 @@ def load_config(start: Path) -> tuple[AnalysisConfig, Path]:
                     "disable",
                     "exclude",
                     "jit_paths",
+                    "engine_dispatch_paths",
                     "counter_extra_prefixes",
                     "module_attrs",
                 ):
@@ -392,6 +403,7 @@ def run_analysis(
         "jit-tracer-branch",
         "jit-static-hygiene",
         "jit-dispatch-sync",
+        "jit-unbucketed-dispatch",
     }:
         from . import jit
 

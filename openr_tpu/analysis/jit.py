@@ -1,6 +1,6 @@
 """Jit-hygiene checkers.
 
-Four rules over the ``jax.jit`` call graphs rooted in the configured
+Five rules over the ``jax.jit`` call graphs rooted in the configured
 ``jit_paths`` (the ops/ kernels and the fleet dispatch layer):
 
 - ``jit-host-sync``: inside a *traced* context (a jitted function, or any
@@ -25,6 +25,12 @@ Four rules over the ``jax.jit`` call graphs rooted in the configured
   Python thread on the device stream.  Deliberate fetch points should use
   a single ``jax.device_get`` and/or carry a suppression explaining why
   the sync is intended.
+- ``jit-unbucketed-dispatch``: daemon modules (analyzed files outside
+  ``jit_paths`` and ``engine_dispatch_paths``) calling a jitted function
+  directly.  Dispatch belongs behind the device-residency engine
+  (``openr_tpu/device``), which buckets shapes, keeps the graph resident
+  and accounts bytes/latency; a direct call silently gets none of that.
+  Deliberate low-level call sites carry rationale suppressions.
 
 The analysis is a fixpoint over an interprocedural "tracedness"
 propagation: jitted roots seed their non-static parameters as traced;
@@ -941,6 +947,55 @@ def _walk_body_silent(self, body, env):
 _DispatchWalker.walk_body_silent = _walk_body_silent
 
 
+# ---------------------------------------------------------------------------
+# Engine-bypass detection (jit-unbucketed-dispatch)
+# ---------------------------------------------------------------------------
+
+
+def _in_engine_paths(rel: str, config: AnalysisConfig) -> bool:
+    for p in config.engine_dispatch_paths:
+        p = p.rstrip("/")
+        if rel == p or rel.startswith(p + "/"):
+            return True
+    return False
+
+
+def _check_unbucketed_dispatch(
+    files: list[SourceFile], reporter: Reporter, config: AnalysisConfig
+) -> None:
+    """Daemon modules must not dispatch jitted kernels directly.
+
+    Every analyzed file outside ``jit_paths`` (the kernel/dispatch layer)
+    and ``engine_dispatch_paths`` (the device-residency engine) is daemon
+    code: a direct call to a jitted function there bypasses the engine
+    front-end, so the dispatch misses shape bucketing, residency sync and
+    the device.engine.* accounting.  Deliberate low-level call sites (the
+    host-mirror library, protection API) carry rationale suppressions.
+    """
+    index = _Index(files)
+    for sf in files:
+        if _in_jit_paths(sf.rel, config) or _in_engine_paths(sf.rel, config):
+            continue
+        fi = index.by_module.get(_module_name(sf.rel))
+        if fi is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            rec = index.resolve_func(fi, node.func)
+            if rec is None or not rec.is_jitted:
+                continue
+            reporter.emit(
+                sf,
+                "jit-unbucketed-dispatch",
+                node,
+                f"direct dispatch of jitted {rec.name}() from a daemon "
+                "module; route through the device engine front-end "
+                "(DeviceResidencyEngine.spf_results/dispatch) so shape "
+                "bucketing, residency and accounting apply",
+            )
+
+
 def _target_names(tgt: ast.AST) -> set[str]:
     out: set[str] = set()
     for sub in ast.walk(tgt):
@@ -960,6 +1015,11 @@ def check(
     config: AnalysisConfig,
     root: Path,
 ) -> None:
+    # R5: engine-bypass dispatch — scans every analyzed file, not just
+    # jit_paths, so it runs before the scope cut below
+    if "jit-unbucketed-dispatch" in config.active_rules():
+        _check_unbucketed_dispatch(files, reporter, config)
+
     scope_files = [sf for sf in files if _in_jit_paths(sf.rel, config)]
     if not scope_files:
         return

@@ -372,6 +372,12 @@ class ChaosSpfBackend:
         self._rng = random.Random(f"{seed}:spf")
         self._lock = threading.Lock()
         self._call_index = 0
+        # device-residency engine seam: faults fire INSIDE the engine's
+        # entry points (sync/spf/fleet_product), so an injected failure
+        # exercises the same ladder a real device fault would
+        engine = getattr(inner, "engine", None)
+        if engine is not None:
+            engine.fault_hook = lambda op: self._gate(f"engine:{op}")
 
     def disarm(self) -> None:
         self.armed = False

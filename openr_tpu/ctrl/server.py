@@ -50,6 +50,7 @@ class OpenrCtrlHandler:
         spark=None,
         monitor=None,
         netlink=None,
+        device=None,
         config=None,
         kvstore_updates_queue: Optional[ReplicateQueue[Publication]] = None,
         fib_updates_queue: Optional[ReplicateQueue] = None,
@@ -69,6 +70,9 @@ class OpenrCtrlHandler:
         self.spark = spark
         self.monitor = monitor
         self.netlink = netlink
+        # device-residency engine (openr_tpu.device.DeviceResidencyEngine):
+        # exports device.engine.* through get_counters like any module
+        self.device = device
         self.config = config
         self.kvstore_updates_queue = kvstore_updates_queue
         self.fib_updates_queue = fib_updates_queue
@@ -285,6 +289,7 @@ class OpenrCtrlHandler:
             self.spark,
             self.monitor,
             self.netlink,
+            self.device,
         ):
             if module is None:
                 continue

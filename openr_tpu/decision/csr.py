@@ -443,8 +443,12 @@ class CsrTopology:
         while True:
             n_sweeps = self._sweep_hint
             if small:
-                # small control-plane query: ONE packed transfer
+                # small control-plane query: ONE packed transfer.  This is
+                # the host fallback of the degradation ladder — the exact
+                # computation the engine's bucketed programs mirror — so
+                # there is no engine front-end to route through here.
                 packed = np.asarray(
+                    # openr: disable=jit-unbucketed-dispatch
                     ops.spf_forward_full_packed(
                         *args,
                         use_link_metric=use_link_metric,
@@ -454,7 +458,9 @@ class CsrTopology:
                 converged = packed[-1] == 1
             else:
                 # bulk batch: int32-widening the dag for packing would
-                # dominate memory; take separate fetches instead
+                # dominate memory; take separate fetches instead.  Same
+                # ladder-fallback rationale as the packed branch above.
+                # openr: disable=jit-unbucketed-dispatch
                 dist_j, dag_j, nh_j, ok_j = ops.spf_forward_full(
                     *args,
                     use_link_metric=use_link_metric,

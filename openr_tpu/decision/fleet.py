@@ -30,6 +30,7 @@ the cache invalidates on version or destination-set change.
 
 from __future__ import annotations
 
+import functools
 import logging
 import weakref
 from typing import Optional
@@ -252,9 +253,13 @@ class FleetRouteView:
     `dest_names` must cover every node route construction asks distances
     to: prefix advertisers + labeled nodes (fleet_destinations)."""
 
-    def __init__(self, csr, dest_names: list[str]) -> None:
+    def __init__(self, csr, dest_names: list[str], engine=None) -> None:
         self.csr = csr
         self.version = csr.version
+        # device-residency engine (openr_tpu.device): when present, the
+        # fleet product dispatches through its front-end (chaos fault
+        # hook + device.engine.* dispatch accounting)
+        self._engine = engine
         self.dest_names = list(dest_names)
         self.p_index = {name: i for i, name in enumerate(self.dest_names)}
         self._node_id = dict(csr.node_id)
@@ -344,7 +349,19 @@ class FleetRouteView:
             if runner.bg is not None
             else None
         )
-        dist, bitmap, ok = asrc.reduced_all_sources(
+        # engine front-end (openr_tpu.device): fault-hook + dispatch
+        # accounting around the fused product; the direct call remains
+        # the engine-less fallback path
+        product = (
+            functools.partial(
+                self._engine.dispatch,
+                "fleet_product",
+                asrc.reduced_all_sources,
+            )
+            if self._engine is not None
+            else asrc.reduced_all_sources
+        )
+        dist, bitmap, ok = product(
             dest_ids,
             runner,
             self._out,
@@ -366,7 +383,7 @@ class FleetRouteView:
             self.warm_mode = None
             if hint_seed is not None:
                 runner.hint = hint_seed
-            dist, bitmap, ok = asrc.reduced_all_sources(
+            dist, bitmap, ok = product(
                 dest_ids,
                 runner,
                 self._out,
@@ -503,7 +520,7 @@ class FleetViewCache:
         )
 
     def view(
-        self, ls: LinkState, dest_names: list[str], csr=None
+        self, ls: LinkState, dest_names: list[str], csr=None, engine=None
     ) -> Optional[FleetRouteView]:
         """Computed view for this (version, dests); None when empty.
 
@@ -529,7 +546,7 @@ class FleetViewCache:
         elif csr.version != ls.version:
             csr.refresh(ls)
         prev = self._views.get(ls)
-        view = FleetRouteView(csr, dest_names)
+        view = FleetRouteView(csr, dest_names, engine=engine)
         key = (csr.n_nodes, csr.n_edges)
         init_from = None
         down_from = None
@@ -569,7 +586,7 @@ class FleetViewCache:
             # set, device error during the seeded relax): retry COLD on a
             # fresh view — the caller reads cold_fallback for counters
             log.warning("fleet: warm-started rebuild failed; retrying cold")
-            view = FleetRouteView(csr, dest_names)
+            view = FleetRouteView(csr, dest_names, engine=engine)
             view.compute(hint_seed=self._hints.get(key))
             view.cold_fallback = True
         if view.sweep_hint is not None:

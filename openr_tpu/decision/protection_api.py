@@ -109,6 +109,9 @@ def what_if(
     # restrict impact counting to real nodes (padding cols are unreachable
     # in baseline too, so they never count, but be explicit)
     real = np.asarray([csr.node_id[n] for n in csr.node_names])
+    # offline what-if analysis over one fixed scenario batch, not the SPF
+    # hot path — no residency or bucket ladder for the engine to apply
+    # openr: disable=jit-unbucketed-dispatch
     unreachable, degraded = prot.srlg_reachability_loss(
         all_dist[0][:, real], all_dist[1:][:, :, real]
     )

@@ -162,10 +162,19 @@ class DeviceSpfBackend:
         min_device_nodes: int = 64,
         min_device_sources: int = 32,
         force_device_nodes: int = 131072,
+        engine=None,
     ) -> None:
         self.min_device_nodes = min_device_nodes
         self.min_device_sources = min_device_sources
         self.force_device_nodes = force_device_nodes
+        # device-residency engine (openr_tpu.device): resident graph
+        # mirrors + bucketed program cache.  All SPF dispatch goes through
+        # it; csr.spf_from remains only as the engine-less fallback.
+        if engine is None:
+            from ..device import DeviceResidencyEngine
+
+            engine = DeviceResidencyEngine()
+        self.engine = engine
         # Keyed on the LinkState object itself (weakly) rather than id():
         # ids are recycled after GC, so an id-keyed cache could serve
         # another topology's results and leaks entries for dead
@@ -238,10 +247,30 @@ class DeviceSpfBackend:
         n = link_state.num_nodes()
         if n < self.min_device_nodes:
             return False
-        return (
+        if (
             n_sources >= self.min_device_sources
             or n >= self.force_device_nodes
-        )
+        ):
+            return True
+        # engine-warm branch: the batch crossover above prices in per-call
+        # staging + jit-cache entry.  With the graph already resident in
+        # the engine, a small-S dispatch pays only the padded bucket
+        # program call, so the comparison flips in the device's favor.
+        if self.engine is not None:
+            csr = self._mirrors.get(link_state)
+            if csr is not None and self.engine.has_residency(csr):
+                return True
+        return False
+
+    def _spf_from(self, csr, sources: list[str], use_link_metric: bool = True):
+        """SPF dispatch front-end: the engine serves from device residency
+        (no per-call staging, bucketed programs); csr.spf_from is the
+        engine-less host-staged fallback."""
+        if self.engine is not None:
+            return self.engine.spf_results(
+                csr, sources, use_link_metric=use_link_metric
+            )
+        return csr.spf_from(sources, use_link_metric=use_link_metric)
 
     def prefetch(self, link_state: LinkState, sources: list[str]) -> None:
         """Compute many sources in one device call and cache them (host
@@ -263,7 +292,7 @@ class DeviceSpfBackend:
                 cache[s] = link_state.get_spf_result(s)
             return
         csr = self._mirror(link_state)
-        cache.update(csr.spf_from(missing))
+        cache.update(self._spf_from(csr, missing))
         self._harvest_hint(csr)
 
     def prefetch_via_mesh(
@@ -343,7 +372,7 @@ class DeviceSpfBackend:
             cache[src] = res
             return res
         csr = self._mirror(link_state)
-        cache.update(csr.spf_from([src]))
+        cache.update(self._spf_from(csr, [src]))
         self._harvest_hint(csr)
         return cache[src]
 
@@ -1334,6 +1363,7 @@ class SpfSolver:
         mirror = getattr(self.spf, "csr_mirror", None)
         min_nodes = getattr(self.spf, "min_device_nodes", None)
         min_sources = getattr(self.spf, "min_device_sources", None)
+        engine = getattr(self.spf, "engine", None)
         for area, ls in area_link_states.items():
             dests = fleet_destinations(ls, prefix_state)
             if not dests:
@@ -1346,7 +1376,10 @@ class SpfSolver:
             cached = self.fleet.is_warm(ls, dests)
             try:
                 view = self.fleet.view(
-                    ls, dests, csr=mirror(ls) if mirror is not None else None
+                    ls,
+                    dests,
+                    csr=mirror(ls) if mirror is not None else None,
+                    engine=engine,
                 )
             except Exception:
                 # fleet-product dispatch failed outright (mirror build or

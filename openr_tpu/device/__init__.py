@@ -1,0 +1,20 @@
+"""Device-residency engine: long-lived device state + bucketed programs.
+
+The serving-engine shape from inference stacks applied to SPF: graph
+mirrors stay resident on the device and are updated incrementally from
+LinkState deltas; variable source-set sizes pad up a small bucket ladder
+of persistently compiled programs with donated scratch, so a control-
+plane query never pays per-call staging or retracing.
+"""
+
+from .engine import (
+    DeviceResidencyEngine,
+    ENGINE_COUNTER_KEYS,
+    S_BUCKETS,
+)
+
+__all__ = [
+    "DeviceResidencyEngine",
+    "ENGINE_COUNTER_KEYS",
+    "S_BUCKETS",
+]

@@ -1,0 +1,498 @@
+"""Persistent device-residency engine for small-batch SPF dispatch.
+
+The measured dispatch policy loses every single-event reconvergence to
+the host because each device call re-stages the graph and re-enters the
+jit cache (VERDICT "What's weak" §3).  This engine removes both taxes:
+
+- **Residency**: one `_Resident` per CsrTopology mirror holds the ELL
+  tables and edge/node attribute arrays on the device.  Attribute flaps
+  (link up/down, metric, drain) are applied *on device* by scatter-free
+  masked writes against host shadow copies — an adjacency flap never
+  re-uploads the graph.  Only an edge-set/node-set rebuild (a new
+  `csr.ell` object) forces a full restage.
+- **Shape-bucketed program cache**: a query for S sources pads up the
+  `S_BUCKETS` ladder and dispatches a persistently compiled program
+  keyed by (topology bucket, S bucket, word count, sweep count, dtype
+  mode, metric mode).  Programs are AOT-compiled
+  (`jax.jit(...).lower(...).compile()`) so LRU eviction actually frees
+  the executable, and the per-query distance scratch is donated
+  (`donate_argnums`) back to the runtime.
+- **Accounting**: every byte that crosses host->device and every
+  staging/compile/dispatch interval is recorded under `device.engine.*`
+  and exported through `OpenrCtrlHandler._all_counters` / the fb303
+  shim.
+
+Failure discipline: any exception thrown here rides the existing
+degradation ladder (SpfSolver catches and falls back to the host
+oracle); the chaos harness injects faults through `fault_hook`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import time
+import warnings
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import sssp as ops
+
+# source-batch padding ladder; above the last rung, next power of two
+S_BUCKETS = (1, 8, 64, 512)
+
+ENGINE_COUNTER_KEYS = (
+    "device.engine.compiles",
+    "device.engine.bucket_hits",
+    "device.engine.bucket_misses",
+    "device.engine.evictions",
+    "device.engine.bytes_staged",
+    "device.engine.incremental_updates",
+    "device.engine.full_restages",
+    "device.engine.queries",
+    "device.engine.dispatches",
+    "device.engine.stage_us",
+    "device.engine.compile_us",
+    "device.engine.dispatch_us",
+)
+
+
+@contextlib.contextmanager
+def _quiet_donation():
+    """CPU backends can't always honor donation and warn per trace;
+    the request is still correct (and honored) on device backends."""
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable"
+        )
+        yield
+
+
+def _s_bucket(s: int) -> int:
+    for b in S_BUCKETS:
+        if s <= b:
+            return b
+    b = S_BUCKETS[-1]
+    while b < s:
+        b *= 2
+    return b
+
+
+def _nbytes(*arrays) -> int:
+    return sum(int(a.size) * int(a.dtype.itemsize) for a in arrays)
+
+
+@functools.partial(jax.jit, static_argnames=("n_cap",))
+def _dist0_T_device(sources, new_of_old, n_cap):
+    # device-built initial distances: the only per-query upload stays the
+    # [S] source-id vector
+    return ops.make_dist0_T(sources, new_of_old, n_cap)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _masked_write_i32(arr, idx, vals):
+    """arr[idx] = vals without a scatter (one scatter knocks the TPU
+    runtime off its fast dispatch path; see ops.sssp.make_dist0_T).
+    `idx` is padded with -1 (never matches), indices are unique."""
+    hit = jnp.arange(arr.shape[0], dtype=jnp.int32)[:, None] == idx[None, :]
+    picked = (hit * vals[None, :]).sum(axis=1)
+    return jnp.where(hit.any(axis=1), picked.astype(arr.dtype), arr)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _masked_write_bool(arr, idx, vals):
+    hit = jnp.arange(arr.shape[0], dtype=jnp.int32)[:, None] == idx[None, :]
+    picked = (hit & vals[None, :]).any(axis=1)
+    return jnp.where(hit.any(axis=1), picked, arr)
+
+
+def _pad_updates(idx: np.ndarray, vals: np.ndarray, pad_val):
+    """Pad (idx, vals) to a small power-of-two K so the masked-write
+    programs bucket by update count instead of retracing per flap."""
+    k = 8
+    while k < len(idx):
+        k *= 2
+    pad = k - len(idx)
+    if pad:
+        idx = np.concatenate([idx, np.full(pad, -1, dtype=np.int32)])
+        vals = np.concatenate([vals, np.full(pad, pad_val, dtype=vals.dtype)])
+    return idx, vals
+
+
+def _forward_body(
+    small: bool, use_link_metric: bool, n_sweeps: int, n_words: int
+):
+    """Program body for one (S bucket, mode) cell — mirrors
+    ops.sssp.spf_forward_full(_packed) but takes the donated distance
+    scratch as its first argument so the runtime reuses its pages."""
+
+    def fn(
+        dist0_T,  # [N_cap, S_bucket] int32 — DONATED
+        sources,  # [S_bucket] int32
+        ell,
+        edge_src,
+        edge_dst,
+        edge_metric,
+        edge_up,
+        node_overloaded,
+        out_slot,
+    ):
+        dist_T, dist_ok = ops.batched_sssp_ell(
+            dist0_T,
+            ell,
+            unit_metric=not use_link_metric,
+            edge_up=edge_up,
+            node_overloaded=node_overloaded,
+            edge_metric=edge_metric,
+            n_sweeps=n_sweeps,
+        )
+        dist_old_T = ops.ell_dist_to_old_T(dist_T, ell)
+        metric = (
+            edge_metric if use_link_metric else jnp.ones_like(edge_metric)
+        )
+        allowed_T = ops.make_relax_allowed_T(
+            sources, edge_src, edge_up, node_overloaded
+        )
+        d_u = jnp.take(dist_old_T, edge_src, axis=0)
+        d_v = jnp.take(dist_old_T, edge_dst, axis=0)
+        dag_T = allowed_T & (d_u < ops.INF32) & (
+            d_u + metric[:, None] == d_v
+        )
+        nh, nh_ok = ops.first_hops_ell(
+            ell, dag_T, out_slot, sources, edge_src, n_words,
+            n_sweeps=n_sweeps,
+        )
+        ok = dist_ok & nh_ok
+        if not small:
+            return dist_old_T.T, dag_T.T, nh, ok
+        # small control-plane query: ONE packed device->host transfer
+        return jnp.concatenate(
+            [
+                dist_old_T.T.ravel(),
+                dag_T.T.ravel().astype(jnp.int32),
+                jax.lax.bitcast_convert_type(nh, jnp.int32).ravel(),
+                ok.astype(jnp.int32)[None],
+            ]
+        )
+
+    return fn
+
+
+@dataclass
+class _Resident:
+    """Device-resident mirror of one CsrTopology + host shadows for
+    diffing.  `ell_host` pins the host ELL object: identity change means
+    csr.refresh() rebuilt the topology and residency must restage."""
+
+    topo_key: tuple
+    ell_host: Any
+    version: int
+    # device arrays
+    ell: Any
+    edge_src: Any
+    edge_dst: Any
+    edge_metric: Any
+    edge_up: Any
+    node_overloaded: Any
+    out_slot: Any
+    # host shadows of the three mutable attribute arrays
+    shadow_metric: np.ndarray = field(repr=False, default=None)
+    shadow_up: np.ndarray = field(repr=False, default=None)
+    shadow_overloaded: np.ndarray = field(repr=False, default=None)
+    sweep_hint: int = 16
+
+
+class DeviceResidencyEngine:
+    """Owns device residency, the bucketed program cache and the
+    `device.engine.*` accounting.  One instance serves every area's
+    CsrTopology mirror (residents key on mirror identity)."""
+
+    def __init__(
+        self,
+        max_programs: int = 16,
+        s_buckets: tuple = S_BUCKETS,
+    ) -> None:
+        self.max_programs = max_programs
+        self.s_buckets = tuple(s_buckets)
+        self.counters: dict[str, int] = {k: 0 for k in ENGINE_COUNTER_KEYS}
+        # (topo_key, s_bucket, n_words, n_sweeps, small, use_link_metric)
+        #   -> AOT-compiled executable; OrderedDict as LRU
+        self._programs: "OrderedDict[tuple, Any]" = OrderedDict()
+        # id(csr) -> _Resident (csr mirrors are long-lived per area)
+        self._residents: dict[int, _Resident] = {}
+        # chaos seam: called with an op name at every engine entry point
+        self.fault_hook: Optional[Callable[[str], None]] = None
+        # per-query attribution (read by bench rows)
+        self.last_query_bytes = 0
+        self.last_query_us = 0
+
+    # -- counters -----------------------------------------------------------
+
+    def get_counters(self) -> dict[str, int]:
+        return dict(self.counters)
+
+    def _bump(self, name: str, delta: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + delta
+
+    # -- residency ----------------------------------------------------------
+
+    def has_residency(self, csr) -> bool:
+        """True when `csr`'s graph is resident (attribute drift is fine —
+        the next sync applies it incrementally, which is cheap; only a
+        topology rebuild forces a restage)."""
+        res = self._residents.get(id(csr))
+        return res is not None and res.ell_host is csr.ell
+
+    def is_warm(self, csr) -> bool:
+        """True when `csr`'s graph is resident and current — the measured
+        dispatch policy flips small-S queries to the device only then."""
+        res = self._residents.get(id(csr))
+        return (
+            res is not None
+            and res.ell_host is csr.ell
+            and res.version == csr.version
+        )
+
+    def sync(self, csr) -> _Resident:
+        """Bring `csr`'s device residency to csr.version.
+
+        Full restage only when the ELL object changed (topology
+        rebuild); attribute-only refreshes diff the host shadows and
+        apply masked writes on device."""
+        if self.fault_hook is not None:
+            self.fault_hook("sync")
+        t0 = time.perf_counter()
+        res = self._residents.get(id(csr))
+        if res is None or res.ell_host is not csr.ell:
+            res = self._restage(csr)
+        elif res.version != csr.version:
+            self._incremental(res, csr)
+        self._bump(
+            "device.engine.stage_us",
+            int((time.perf_counter() - t0) * 1e6),
+        )
+        return res
+
+    def _restage(self, csr) -> _Resident:
+        host_arrays = (
+            csr.edge_src,
+            csr.edge_dst,
+            csr.edge_metric,
+            csr.edge_up,
+            csr.node_overloaded,
+            csr.out_slot,
+        )
+        ell_leaves = jax.tree_util.tree_leaves(csr.ell)
+        staged = _nbytes(*host_arrays) + _nbytes(
+            *(np.asarray(leaf) for leaf in ell_leaves)
+        )
+        res = _Resident(
+            topo_key=(csr.node_capacity, csr.edge_capacity),
+            ell_host=csr.ell,
+            version=csr.version,
+            ell=jax.device_put(csr.ell),
+            edge_src=jax.device_put(csr.edge_src),
+            edge_dst=jax.device_put(csr.edge_dst),
+            edge_metric=jax.device_put(csr.edge_metric),
+            edge_up=jax.device_put(csr.edge_up),
+            node_overloaded=jax.device_put(csr.node_overloaded),
+            out_slot=jax.device_put(csr.out_slot),
+            shadow_metric=csr.edge_metric.copy(),
+            shadow_up=csr.edge_up.copy(),
+            shadow_overloaded=csr.node_overloaded.copy(),
+            sweep_hint=csr._sweep_hint,
+        )
+        self._residents[id(csr)] = res
+        self._bump("device.engine.full_restages")
+        self._bump("device.engine.bytes_staged", staged)
+        return res
+
+    def _incremental(self, res: _Resident, csr) -> None:
+        """Apply attribute deltas (metric writes / up masks / overload
+        flips) on device.  Upload cost is O(changed entries), padded to a
+        small power-of-two bucket — never the graph."""
+        staged = 0
+        for attr, shadow, host, write in (
+            ("edge_metric", res.shadow_metric, csr.edge_metric,
+             _masked_write_i32),
+            ("edge_up", res.shadow_up, csr.edge_up, _masked_write_bool),
+            ("node_overloaded", res.shadow_overloaded, csr.node_overloaded,
+             _masked_write_bool),
+        ):
+            changed = np.flatnonzero(shadow != host)
+            if changed.size == 0:
+                continue
+            idx = changed.astype(np.int32)
+            vals = host[changed]
+            idx, vals = _pad_updates(
+                idx, vals, pad_val=vals.dtype.type(0)
+            )
+            with _quiet_donation():
+                setattr(res, attr, write(getattr(res, attr), idx, vals))
+            staged += _nbytes(idx, vals)
+            shadow[changed] = host[changed]
+        res.version = csr.version
+        self._bump("device.engine.incremental_updates")
+        if staged:
+            self._bump("device.engine.bytes_staged", staged)
+
+    def drop(self, csr) -> None:
+        """Forget `csr`'s residency (mirror retired)."""
+        self._residents.pop(id(csr), None)
+
+    # -- program cache ------------------------------------------------------
+
+    def cached_program_keys(self) -> list[tuple]:
+        return list(self._programs.keys())
+
+    def _program(self, key: tuple, example_args: tuple):
+        cached = self._programs.get(key)
+        if cached is not None:
+            self._programs.move_to_end(key)
+            self._bump("device.engine.bucket_hits")
+            return cached
+        self._bump("device.engine.bucket_misses")
+        t0 = time.perf_counter()
+        _topo, _sb, n_words, n_sweeps, small, use_link_metric = key
+        fn = _forward_body(small, use_link_metric, n_sweeps, n_words)
+        # AOT: lower+compile now so the jit cache never owns the
+        # executable — LRU eviction below genuinely frees it
+        with _quiet_donation():
+            compiled = (
+                jax.jit(fn, donate_argnums=(0,))
+                .lower(*example_args)
+                .compile()
+            )
+        self._bump("device.engine.compiles")
+        self._bump(
+            "device.engine.compile_us",
+            int((time.perf_counter() - t0) * 1e6),
+        )
+        self._programs[key] = compiled
+        while len(self._programs) > self.max_programs:
+            self._programs.popitem(last=False)
+            self._bump("device.engine.evictions")
+        return compiled
+
+    # -- queries ------------------------------------------------------------
+
+    def spf_results(self, csr, sources: list, use_link_metric: bool = True):
+        """Full production pipeline through residency: distances + SP-DAG
+        + bit-packed first hops -> reference-shaped SpfResults.  Same
+        contract as CsrTopology.spf_from, minus the per-call staging."""
+        if self.fault_hook is not None:
+            self.fault_hook("spf")
+        if not sources:
+            return {}
+        t_query = time.perf_counter()
+        bytes_before = self.counters["device.engine.bytes_staged"]
+        res = self.sync(csr)
+
+        src_ids = np.asarray(
+            [csr.node_id[s] for s in sources], dtype=np.int32
+        )
+        s = len(sources)
+        s_bucket = _s_bucket(s)
+        if s_bucket > s:
+            # pad with the first source: pad rows compute real (discarded)
+            # results, so the convergence verdict stays meaningful
+            src_ids = np.concatenate(
+                [src_ids, np.full(s_bucket - s, src_ids[0], np.int32)]
+            )
+        # topology-wide word count (not per-batch): keeps the program key
+        # stable across source sets; unset high words decode to no bits
+        n_words = max(1, -(-csr.max_out_slots // 32))
+        n_cap = csr.node_capacity
+        small = s_bucket * n_cap <= (1 << 21)
+
+        t0 = time.perf_counter()
+        while True:
+            n_sweeps = res.sweep_hint
+            key = (
+                res.topo_key,
+                s_bucket,
+                n_words,
+                n_sweeps,
+                small,
+                use_link_metric,
+            )
+            src_dev = jax.device_put(src_ids)
+            self._bump("device.engine.bytes_staged", _nbytes(src_ids))
+            dist0_T = _dist0_T_device(
+                src_dev, res.ell.new_of_old, n_cap
+            )
+            args = (
+                dist0_T,
+                src_dev,
+                res.ell,
+                res.edge_src,
+                res.edge_dst,
+                res.edge_metric,
+                res.edge_up,
+                res.node_overloaded,
+                res.out_slot,
+            )
+            compiled = self._program(key, args)
+            out = compiled(*args)
+            if small:
+                packed = np.asarray(out)
+                converged = packed[-1] == 1
+            else:
+                dist_j, dag_j, nh_j, ok_j = out
+                converged = bool(ok_j)
+            if converged:
+                break
+            res.sweep_hint = n_sweeps * 2
+            # share the learned relax depth with the host-staged path
+            csr._sweep_hint = res.sweep_hint
+        if small:
+            n_dist = s_bucket * n_cap
+            n_dag = s_bucket * csr.edge_capacity
+            dist = packed[:n_dist].reshape(s_bucket, n_cap)
+            dag = (
+                packed[n_dist : n_dist + n_dag].reshape(
+                    s_bucket, csr.edge_capacity
+                )
+                != 0
+            )
+            nh = (
+                packed[n_dist + n_dag : -1]
+                .view(np.uint32)
+                .reshape(s_bucket, n_cap, n_words)
+            )
+        else:
+            dist = np.asarray(dist_j)
+            dag = np.asarray(dag_j)
+            nh = np.asarray(nh_j)
+        self._bump(
+            "device.engine.dispatch_us",
+            int((time.perf_counter() - t0) * 1e6),
+        )
+        self._bump("device.engine.queries")
+        self.last_query_bytes = (
+            self.counters["device.engine.bytes_staged"] - bytes_before
+        )
+        self.last_query_us = int((time.perf_counter() - t_query) * 1e6)
+        return csr.to_spf_results(sources, dist[:s], dag[:s], nh[:s])
+
+    def dispatch(self, op: str, fn: Callable, *args, **kwargs):
+        """Generic dispatch front-end for device work that is not an SPF
+        query (fleet product, KSP re-runs): routes through the chaos
+        fault hook and the dispatch accounting without changing the
+        callee's contract."""
+        if self.fault_hook is not None:
+            self.fault_hook(op)
+        t0 = time.perf_counter()
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            self._bump("device.engine.dispatches")
+            self._bump(
+                "device.engine.dispatch_us",
+                int((time.perf_counter() - t0) * 1e6),
+            )

@@ -139,6 +139,38 @@ _REGEX_ARGS = tb.StructSpec(
         ),
     ),
 )
+# OpenrCtrl.thrift:430 longPollKvStoreAdjArea(1: area, 2: KeyVals snapshot)
+# and the deprecated area-less longPollKvStoreAdj(1: KeyVals snapshot)
+_SNAPSHOT_SPEC = ("map", tb.T_STRING, ("struct", tb.VALUE))
+_LONG_POLL_ARGS = tb.StructSpec(
+    "longPollKvStoreAdj_args",
+    None,
+    (
+        tb.Field(
+            1,
+            "snapshot",
+            _SNAPSHOT_SPEC,
+            dec=lambda m: {k.decode(): v for k, v in m.items()},
+            default={},
+        ),
+    ),
+)
+_LONG_POLL_AREA_ARGS = tb.StructSpec(
+    "longPollKvStoreAdjArea_args",
+    None,
+    (
+        tb.Field(
+            1, "area", tb.T_STRING, dec=lambda b: b.decode(), default="0"
+        ),
+        tb.Field(
+            2,
+            "snapshot",
+            _SNAPSHOT_SPEC,
+            dec=lambda m: {k.decode(): v for k, v in m.items()},
+            default={},
+        ),
+    ),
+)
 
 
 class ThriftBinaryShim(OpenrEventBase):
@@ -153,6 +185,8 @@ class ThriftBinaryShim(OpenrEventBase):
         decision=None,
         fib=None,
         counters_fn=None,
+        kvstore_updates_queue=None,
+        long_poll_timeout_s: float = 20.0,
     ) -> None:
         super().__init__(name="thrift-shim")
         self.kvstore = kvstore
@@ -164,6 +198,10 @@ class ThriftBinaryShim(OpenrEventBase):
         # () -> dict[str, int]: the daemon passes the ctrl server's
         # merged per-module counter dump (fb303 getCounters semantics)
         self.counters_fn = counters_fn
+        # ReplicateQueue[Publication]: longPollKvStoreAdj blocks on it
+        # (same wiring as the native ctrl server's _long_poll_adj)
+        self.kvstore_updates_queue = kvstore_updates_queue
+        self.long_poll_timeout_s = long_poll_timeout_s
         self._server: Optional[asyncio.AbstractServer] = None
 
     def _fib(self):
@@ -205,13 +243,24 @@ class ThriftBinaryShim(OpenrEventBase):
                 if not 0 < length <= MAX_FRAME:
                     raise tb.ThriftError(f"bad frame length {length}")
                 msg = await reader.readexactly(length)
-                # the KvStore calls block on a cross-thread Future with no
-                # timeout; off the loop thread so one busy/stopped KvStore
-                # cannot wedge every other shim connection (and stop()'s
-                # _close, which runs on this same loop)
-                reply = await asyncio.get_running_loop().run_in_executor(
-                    None, self._serve, msg
-                )
+                name, mtype, seqid, r = tb.decode_message(msg)
+                if mtype == tb.MSG_CALL and name in (
+                    "longPollKvStoreAdj",
+                    "longPollKvStoreAdjArea",
+                ):
+                    # long poll blocks on the kvstore updates queue: keep
+                    # it on the loop (async queue reader) rather than
+                    # parking an executor thread for up to the timeout
+                    reply = await self._long_poll_adj(name, seqid, r)
+                else:
+                    # the KvStore calls block on a cross-thread Future
+                    # with no timeout; off the loop thread so one
+                    # busy/stopped KvStore cannot wedge every other shim
+                    # connection (and stop()'s _close, which runs on this
+                    # same loop)
+                    reply = await asyncio.get_running_loop().run_in_executor(
+                        None, self._serve, msg
+                    )
                 writer.write(tb.frame(reply))
                 await writer.drain()
         except (asyncio.IncompleteReadError, ConnectionResetError):
@@ -220,6 +269,74 @@ class ThriftBinaryShim(OpenrEventBase):
             log.warning("thrift shim: %s", exc)
         finally:
             writer.close()
+
+    # -- long poll (reference: longPollKvStoreAdjArea,
+    #    OpenrCtrl.thrift:430 / OpenrCtrlHandler.h:269) --------------------
+
+    async def _long_poll_adj(self, name: str, seqid: int, r) -> bytes:
+        """Resolve True when any adj: key moves beyond the client's
+        version snapshot, False on timeout — the native ctrl server's
+        _long_poll_adj semantics on the thrift-binary wire."""
+        from ..runtime.queue import QueueClosedError
+        from ..types import ADJ_MARKER
+
+        try:
+            if name == "longPollKvStoreAdjArea":
+                args = tb.read_struct(r, _LONG_POLL_AREA_ARGS)
+            else:
+                args = tb.read_struct(r, _LONG_POLL_ARGS)
+            area = args.get("area", "0")
+            snapshot = {
+                key: val.version
+                for key, val in (args.get("snapshot") or {}).items()
+            }
+            queue = self.kvstore_updates_queue
+            if queue is None:
+                raise RuntimeError("kvstore updates queue not attached")
+            loop = asyncio.get_running_loop()
+            # the reader is registered BEFORE the snapshot comparison so a
+            # publication racing the dump is never lost
+            q_reader = queue.get_reader()
+            try:
+                current = await loop.run_in_executor(
+                    None,
+                    lambda: self.kvstore.dump_all(
+                        area, key_prefixes=[ADJ_MARKER]
+                    ),
+                )
+                changed = any(
+                    snapshot.get(key) != val.version
+                    for key, val in current.key_vals.items()
+                )
+                deadline = loop.time() + self.long_poll_timeout_s
+                while not changed:
+                    timeout = deadline - loop.time()
+                    if timeout <= 0:
+                        return self._reply(name, seqid, tb.T_BOOL, False)
+                    try:
+                        pub = await asyncio.wait_for(
+                            q_reader.aget(), timeout
+                        )
+                    except (asyncio.TimeoutError, QueueClosedError):
+                        return self._reply(name, seqid, tb.T_BOOL, False)
+                    if pub.area != area:
+                        continue
+                    changed = any(
+                        key.startswith(ADJ_MARKER)
+                        and snapshot.get(key) != val.version
+                        for key, val in pub.key_vals.items()
+                    ) or any(
+                        key.startswith(ADJ_MARKER)
+                        for key in pub.expired_keys
+                    )
+                return self._reply(name, seqid, tb.T_BOOL, True)
+            finally:
+                queue.close_reader(q_reader)
+        except tb.ThriftError:
+            raise
+        except Exception as exc:
+            log.warning("thrift shim %s failed: %s", name, exc)
+            return tb.encode_application_exception(name, seqid, str(exc))
 
     # -- dispatch ------------------------------------------------------------
 

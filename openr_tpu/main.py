@@ -322,6 +322,9 @@ class OpenrDaemon:
             monitor=self.monitor,
             netlink=self.netlink,
             config=self.config,
+            # device-residency engine counters (device.engine.*) ride the
+            # same getCounters surface as every module's
+            device=getattr(self.decision.spf_solver.spf, "engine", None),
             kvstore_updates_queue=self.kvstore_updates_queue,
             fib_updates_queue=self.fib_updates_queue,
             config_store=self.config_store,
@@ -352,6 +355,7 @@ class OpenrDaemon:
                 decision=self.decision,
                 fib=self.fib,
                 counters_fn=self.ctrl_server.handler._all_counters,
+                kvstore_updates_queue=self.kvstore_updates_queue,
             )
             self.thrift_shim.run()
         if self.watchdog is not None:

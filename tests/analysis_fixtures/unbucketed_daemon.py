@@ -1,0 +1,38 @@
+"""Daemon layer for the jit-unbucketed-dispatch fixture.
+
+Outside jit_paths and engine_dispatch_paths, so every direct jitted call
+here is a seeded violation; the plain-helper call and the rationale-
+suppressed call stay silent.
+"""
+
+import jax
+
+from . import unbucketed_ops as uops
+from .unbucketed_ops import kernel_add, plain_helper
+
+
+def _adhoc_kernel(a):
+    return a * 2
+
+
+_adhoc_jit = jax.jit(_adhoc_kernel)
+
+
+def handle_query(a, b):
+    out = kernel_add(a, b)
+    return uops.kernel_scale(out, 2)
+
+
+def handle_adhoc(a):
+    return _adhoc_jit(a)
+
+
+def handle_host(a):
+    return plain_helper(a)
+
+
+def handle_pinned(a, b):
+    # caller pins one shape for the process lifetime; measured faster than
+    # engine dispatch and exempt from bucketing by design
+    # openr: disable=jit-unbucketed-dispatch
+    return kernel_add(a, b)

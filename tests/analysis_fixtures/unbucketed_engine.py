@@ -1,0 +1,11 @@
+"""Sanctioned dispatch front-end for the jit-unbucketed-dispatch fixture.
+
+Listed under engine_dispatch_paths in the test config: its direct jitted
+calls model the device-residency engine and must not be flagged.
+"""
+
+from .unbucketed_ops import kernel_add
+
+
+def engine_dispatch(a, b):
+    return kernel_add(a, b)

@@ -1,0 +1,23 @@
+"""Kernel layer for the jit-unbucketed-dispatch fixture (in jit_paths).
+
+Defines jitted roots the daemon fixture calls directly; kept free of
+other jit-hygiene violations so the rule assertions stay exact.
+"""
+
+import functools
+
+import jax
+
+
+@jax.jit
+def kernel_add(a, b):
+    return a + b
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def kernel_scale(a, n):
+    return a * n
+
+
+def plain_helper(a):
+    return a

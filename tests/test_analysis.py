@@ -85,6 +85,53 @@ class TestJitRules:
             assert line not in flagged_lines
 
 
+class TestUnbucketedDispatchRule:
+    """jit-unbucketed-dispatch spans three fixture layers: kernels
+    (jit_paths), the sanctioned front-end (engine_dispatch_paths), and a
+    daemon module whose direct jitted calls are the seeded violations."""
+
+    def _findings(self):
+        config = AnalysisConfig(
+            jit_paths=["tests/analysis_fixtures/unbucketed_ops.py"],
+            engine_dispatch_paths=[
+                "tests/analysis_fixtures/unbucketed_engine.py"
+            ],
+        )
+        targets = [
+            FIXTURES / n
+            for n in (
+                "unbucketed_ops.py",
+                "unbucketed_daemon.py",
+                "unbucketed_engine.py",
+            )
+        ]
+        return run_analysis(targets, config, REPO_ROOT)
+
+    def test_seeded_violations_by_rule_and_line(self):
+        # 22: decorated @jax.jit root, 23: partial-jit via module alias,
+        # 27: ad-hoc jax.jit wrapper assembled inside the daemon module
+        rep = self._findings()
+        assert _pairs(rep) == [
+            ("jit-unbucketed-dispatch", 22),
+            ("jit-unbucketed-dispatch", 23),
+            ("jit-unbucketed-dispatch", 27),
+        ]
+
+    def test_rationale_suppression_is_honored(self):
+        rep = self._findings()
+        assert [(s.rule, s.line) for s in rep.suppressed] == [
+            ("jit-unbucketed-dispatch", 38)
+        ]
+
+    def test_kernel_and_engine_layers_exempt(self):
+        # the engine front-end and the kernel layer both dispatch jitted
+        # functions legitimately; only the daemon module may be flagged
+        rep = self._findings()
+        assert all(
+            f.path.endswith("unbucketed_daemon.py") for f in rep.findings
+        )
+
+
 class TestThreadRules:
     def test_seeded_violations_by_rule_and_line(self):
         rep = _fixture_findings("thread_violations.py")

@@ -415,6 +415,9 @@ class TestCounterRegistrySweep:
                 "queue.netlink_events.writes",
                 "fib.sync_fib_calls",
                 "fib.agent.sync_fib",
+                # the device-residency engine pre-seeds its registry, so
+                # the family is dumpable before any device query runs
+                "device.engine.queries",
             ):
                 assert key in counters, f"{key} missing from getCounters"
 
@@ -424,3 +427,41 @@ class TestCounterRegistrySweep:
             assert not bad, f"non-conventional counter keys: {bad}"
         finally:
             client.close()
+
+    def test_engine_family_on_both_wire_surfaces(self, daemon):
+        """The full device.engine.* registry answers ONE getCounters on
+        the native ctrl server AND the thrift-binary fb303 shim — no
+        per-key plumbing, the engine rides _all_counters like any
+        module."""
+        from openr_tpu.device import ENGINE_COUNTER_KEYS
+        from openr_tpu.interop import thrift_binary as tb
+        from openr_tpu.interop.shim import ThriftBinaryShim
+        from test_thrift_binary import _call_ok
+
+        client = CtrlClient(port=daemon.ctrl_port)
+        try:
+            native = client.call("getCounters")
+        finally:
+            client.close()
+        assert set(ENGINE_COUNTER_KEYS) <= set(native)
+
+        shim = ThriftBinaryShim(
+            daemon.kvstore,
+            port=0,
+            node_name="solo",
+            counters_fn=daemon.ctrl_server.handler._all_counters,
+        )
+        shim.run()
+        try:
+            shimmed = _call_ok(
+                shim.port,
+                "getCounters",
+                41,
+                b"\x00",
+                ("map", tb.T_STRING, tb.T_I64),
+                dec=lambda m: {k.decode(): v for k, v in m.items()},
+            )
+        finally:
+            shim.stop()
+            shim.wait_until_stopped(5)
+        assert set(ENGINE_COUNTER_KEYS) <= set(shimmed)

@@ -1483,3 +1483,119 @@ class TestMultiEventSequences:
             ls.decrement_holds()
         db = routes("1", {"0": ls}, ps)
         assert nh_names(db.unicast_routes[PFX]) == {"3"}
+
+
+class TestLongPublicationSequenceEngineBacked:
+    """Satellite (PR 5): a 25-event publication sequence — adjacency and
+    prefix updates interleaved with TTL expiry — against ONE persistent
+    solver pair: host Dijkstra vs the device backend routed through the
+    residency engine.  Persistence is the point: the engine must absorb
+    the whole stream through its incremental-residency path (fresh
+    solvers per event would re-upload the graph and prove nothing).
+    Ancestors: DecisionTestFixture BasicOperations (:4787) and
+    PubDebouncing (:6024) event streams."""
+
+    P2 = "::2:0/112"
+    P3 = "::3:0/112"
+
+    @staticmethod
+    def ring6(m12=10, m56=10):
+        return {
+            "1": [adj("1", "2", metric=m12), adj("1", "3")],
+            "2": [adj("2", "1", metric=m12), adj("2", "4")],
+            "3": [adj("3", "1"), adj("3", "5")],
+            "4": [adj("4", "2"), adj("4", "6")],
+            "5": [adj("5", "3"), adj("5", "6", metric=m56)],
+            "6": [adj("6", "4"), adj("6", "5", metric=m56)],
+        }
+
+    def test_25_event_stream_parity_and_incremental_residency(self):
+        ls = build_link_state(self.ring6())
+        ps = PrefixState()
+        host = SpfSolver("1")
+        backend = DeviceSpfBackend(min_device_nodes=1, min_device_sources=1)
+        device = SpfSolver("1", spf_backend=backend)
+        engine = backend.engine
+        assert engine is not None
+        events = 0
+
+        def check():
+            h = host.build_route_db({"0": ls}, ps)
+            d = device.build_route_db({"0": ls}, ps)
+            assert h.unicast_routes == d.unicast_routes, events
+            assert h.mpls_routes == d.mpls_routes, events
+            return h
+
+        def pub(node, adjs, **kw):
+            ls.update_adjacency_database(
+                AdjacencyDatabase(
+                    this_node_name=node, adjacencies=adjs, area="0", **kw
+                )
+            )
+
+        def step(mutate):
+            nonlocal events
+            mutate()
+            events += 1
+            return check()
+
+        r = self.ring6()
+        # 1-2: prefix advertisements land
+        db = step(lambda: ps.update_prefix("6", "0", PrefixEntry(prefix=PFX)))
+        assert PFX in db.unicast_routes
+        step(lambda: ps.update_prefix("4", "0", PrefixEntry(prefix=self.P2)))
+        # 3-4: metric raise + restore on the 1-2 arm
+        step(lambda: pub("1", self.ring6(m12=50)["1"]))
+        step(lambda: pub("1", r["1"]))
+        # 5-7: transit drain of node 2 around a new advertisement
+        step(lambda: pub("2", r["2"], is_overloaded=True))
+        step(lambda: ps.update_prefix("3", "0", PrefixEntry(prefix=self.P3)))
+        step(lambda: pub("2", r["2"]))
+        # 8-10: link 1-3 down (edge-set change), prefix TTL expiry of
+        # node 3's announcements, link back up
+        step(lambda: pub("1", [adj("1", "2")]))
+        db = step(lambda: ps.delete_all_from_node("3", "0"))
+        assert self.P3 not in db.unicast_routes
+        step(lambda: pub("1", r["1"]))
+        # 11-13: far-side metric churn; node 4's adjacency database
+        # TTL-expires wholesale, then the node re-announces
+        step(lambda: pub("5", self.ring6(m56=77)["5"]))
+        step(lambda: ls.delete_adjacency_database("4"))
+        step(lambda: pub("4", r["4"]))
+        # 14-17: duplicate re-advertisement, metric restore, overload
+        # pulse on node 5
+        step(lambda: ps.update_prefix("6", "0", PrefixEntry(prefix=PFX)))
+        step(lambda: pub("5", r["5"]))
+        step(lambda: pub("5", r["5"], is_overloaded=True))
+        step(lambda: pub("5", r["5"]))
+        # 18-19: prefix TTL expiry of P2, re-advertised by a new owner
+        step(lambda: ps.delete_prefix("4", "0", self.P2))
+        db = step(
+            lambda: ps.update_prefix("2", "0", PrefixEntry(prefix=self.P2))
+        )
+        assert self.P2 in db.unicast_routes
+        # 20-22: metric shift, link 2-4 flap
+        step(lambda: pub("1", self.ring6(m12=15)["1"]))
+        step(lambda: pub("2", [adj("2", "1", metric=15)]))
+        step(lambda: pub("2", self.ring6(m12=15)["2"]))
+        # 23-25: own-node overload pulse, then settle
+        step(lambda: pub("1", self.ring6(m12=15)["1"], is_overloaded=True))
+        step(lambda: pub("1", self.ring6(m12=15)["1"]))
+        db = step(lambda: ps.update_prefix("5", "0", PrefixEntry(prefix=self.P3)))
+        assert self.P3 in db.unicast_routes
+
+        assert events == 25
+        # the stream really went through the engine, and mostly through
+        # its incremental path (edge-set changes legitimately restage)
+        c = engine.get_counters()
+        assert c["device.engine.queries"] > 0
+        assert c["device.engine.incremental_updates"] >= 10
+        # initial upload + six edge-set changes (link 1-3 down/up, adj-db
+        # expiry + re-announce of node 4, link 2-4 down/up) — everything
+        # else must have gone through the incremental path
+        assert c["device.engine.full_restages"] == 7
+        # settled state matches a freshly-built equivalent topology on
+        # fresh solvers (the routes() harness)
+        fresh = build_link_state(self.ring6(m12=15))
+        db_fresh = routes("1", {"0": fresh}, ps)
+        assert db_fresh.unicast_routes == check().unicast_routes

@@ -1,0 +1,187 @@
+"""Acceptance gate for the device-residency engine (openr_tpu/device).
+
+The scripted 25-flap sequence drives one LinkState through metric raises
+and restores, node-overload set/clear and link-overload set/clear — both
+directions of every knob — while querying the engine at source-set sizes
+that cross shape-bucket boundaries.  Every step is asserted bit-exact
+against the host Dijkstra oracle (LinkState.run_spf), and the counters
+must prove the residency contract:
+
+- ``full_restages == 1``: the graph is uploaded once, at first contact;
+  all 25 flaps thereafter sync incrementally on device;
+- bucket changes force >= 1 recompile of an evicted key, and the small
+  ``max_programs`` budget forces >= 1 eviction;
+- per-query staged bytes stay O(sources + changed slots), never O(graph)
+  (the recorded attribution is the CPU-CI stand-in for the wan-scale
+  device_vs_host wall claim; see docs/OPERATIONS.md).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from openr_tpu.decision import LinkState
+from openr_tpu.decision.csr import CsrTopology
+from openr_tpu.device import (
+    ENGINE_COUNTER_KEYS,
+    S_BUCKETS,
+    DeviceResidencyEngine,
+)
+from openr_tpu.utils.topo import grid_topology
+
+from test_link_state import build
+
+
+def _assert_oracle(engine, csr, ls, sources):
+    got = engine.spf_results(csr, sources)
+    assert set(got) == set(sources)
+    for src in sources:
+        oracle = ls.run_spf(src)
+        res = got[src]
+        assert {k: v.metric for k, v in oracle.items()} == {
+            k: v.metric for k, v in res.items()
+        }, src
+        for n in oracle:
+            assert oracle[n].next_hops == res[n].next_hops, (src, n)
+
+
+def _flap_script(dbs):
+    """25 attribute-only mutations: (db, kind, link, value) tuples.
+
+    Attribute-only is load-bearing: none of these change the edge set, so
+    csr.refresh stays in place and the engine must absorb every one of
+    them as an incremental device update (full_restages frozen at 1).
+    """
+    muts = []
+    # metric raise + restore on six distinct directed links
+    for i in range(6):
+        db = dbs[2 * i]
+        lnk = db.adjacencies[0]
+        muts.append((db, "metric", lnk, 40 + 10 * i))
+        muts.append((db, "metric", lnk, 10))
+    # node overload set + clear on four distinct nodes
+    for i in range(4):
+        db = dbs[3 * i + 1]
+        muts.append((db, "node_overload", None, True))
+        muts.append((db, "node_overload", None, False))
+    # link overload (soft link-down) set + clear on two links
+    for i in range(2):
+        db = dbs[5 * i + 2]
+        lnk = db.adjacencies[-1]
+        muts.append((db, "link_overload", lnk, True))
+        muts.append((db, "link_overload", lnk, False))
+    # one unrestored metric change so the sequence ends off-baseline
+    muts.append((dbs[7], "metric", dbs[7].adjacencies[1], 33))
+    assert len(muts) == 25
+    return muts
+
+
+class TestTwentyFiveFlapSequence:
+    def test_bit_exact_with_incremental_residency(self):
+        dbs = grid_topology(5)  # 25 nodes, node_capacity 32
+        ls = build(dbs)
+        csr = CsrTopology.from_link_state(ls)
+        names = ls.node_names
+        # max_programs=2 with three source buckets in rotation: the third
+        # key always evicts one of the other two, so the next rotation
+        # recompiles it — the eviction/recompile half of the acceptance
+        engine = DeviceResidencyEngine(max_programs=2)
+
+        # first contact: the one and only full staging
+        _assert_oracle(engine, csr, ls, [names[0]])
+        c = engine.get_counters()
+        assert c["device.engine.full_restages"] == 1
+        initial_bytes = c["device.engine.bytes_staged"]
+        assert initial_bytes > 0
+
+        attribution = []  # (flap index, staged bytes, query us)
+        for i, (db, kind, lnk, val) in enumerate(_flap_script(dbs)):
+            if kind == "metric":
+                lnk.metric = val
+            elif kind == "node_overload":
+                db.is_overloaded = val
+            else:
+                lnk.is_overloaded = val
+            ls.update_adjacency_database(db)
+            assert csr.refresh(ls) is True, (i, kind)  # stayed in place
+            # rotate source-set sizes across the 1 / 8 / 64 buckets
+            size = (1, 5, 25)[i % 3]
+            start = i % len(names)
+            sources = (names + names)[start : start + size]
+            _assert_oracle(engine, csr, ls, sources)
+            attribution.append(
+                (i, engine.last_query_bytes, engine.last_query_us)
+            )
+
+        c = engine.get_counters()
+        # the residency contract: one upload, then 25 incremental syncs
+        assert c["device.engine.full_restages"] == 1
+        assert c["device.engine.incremental_updates"] == 25
+        assert c["device.engine.queries"] == 26
+        # three bucket keys under a two-program budget
+        assert len(engine.cached_program_keys()) <= 2
+        assert c["device.engine.evictions"] >= 1
+        assert c["device.engine.compiles"] >= 4  # >=1 key compiled twice
+        assert c["device.engine.bucket_misses"] == c["device.engine.compiles"]
+        assert (
+            c["device.engine.bucket_hits"]
+            == c["device.engine.queries"] - c["device.engine.compiles"]
+        )
+        # per-query attribution: every warm query stages O(sources +
+        # changed slots) bytes, nowhere near the initial graph upload
+        worst = max(b for _, b, _us in attribution)
+        assert worst < initial_bytes / 4, (worst, initial_bytes)
+        assert all(us >= 0 for _, _b, us in attribution)
+
+    def test_counters_pre_seeded_and_registry_shaped(self):
+        engine = DeviceResidencyEngine()
+        c = engine.get_counters()
+        assert set(ENGINE_COUNTER_KEYS) <= set(c)
+        assert all(v == 0 for v in c.values())
+        assert all(k.startswith("device.engine.") for k in c)
+
+    def test_bucket_ladder_is_monotone(self):
+        assert S_BUCKETS == (1, 8, 64, 512)
+
+
+class TestResidencyIdentity:
+    def test_edge_set_change_forces_restage(self):
+        """A rebuild (new ELL identity) is the one legitimate second
+        upload; attribute flaps before and after stay incremental."""
+        dbs = grid_topology(4)
+        ls = build(dbs)
+        csr = CsrTopology.from_link_state(ls)
+        engine = DeviceResidencyEngine()
+        _assert_oracle(engine, csr, ls, ls.node_names[:2])
+        assert engine.has_residency(csr) and engine.is_warm(csr)
+
+        # attribute flap: incremental
+        dbs[0].adjacencies[0].metric = 25
+        ls.update_adjacency_database(dbs[0])
+        assert csr.refresh(ls) is True
+        assert engine.has_residency(csr) and not engine.is_warm(csr)
+        _assert_oracle(engine, csr, ls, ls.node_names[:2])
+
+        # edge-set change: rebuild -> new ell -> full restage
+        dbs[1].adjacencies = [
+            a
+            for a in dbs[1].adjacencies
+            if a.other_node_name != dbs[1].adjacencies[-1].other_node_name
+        ]
+        ls.update_adjacency_database(dbs[1])
+        assert csr.refresh(ls) is False  # rebuilt
+        _assert_oracle(engine, csr, ls, ls.node_names[:2])
+        c = engine.get_counters()
+        assert c["device.engine.full_restages"] == 2
+        assert c["device.engine.incremental_updates"] == 1
+
+    def test_drop_releases_residency(self):
+        ls = build(grid_topology(3))
+        csr = CsrTopology.from_link_state(ls)
+        engine = DeviceResidencyEngine()
+        engine.spf_results(csr, ls.node_names[:1])
+        assert engine.has_residency(csr)
+        engine.drop(csr)
+        assert not engine.has_residency(csr)
+        engine.spf_results(csr, ls.node_names[:1])
+        assert engine.get_counters()["device.engine.full_restages"] == 2

@@ -427,6 +427,167 @@ class TestShimExchange:
         assert peers == {}  # single-node daemon: no peers
 
 
+class TestShimLongPoll:
+    """longPollKvStoreAdjArea / deprecated longPollKvStoreAdj over the
+    wire (reference OpenrCtrl.thrift:424-431): the client sends its
+    adj-key version snapshot; the shim answers true immediately when the
+    snapshot is stale, blocks on the daemon's kvstore publication queue
+    when it is current, and resolves true the moment an adj key
+    advances — false only at timeout.  Mirrors the native ctrl server's
+    _long_poll_adj plus the shim-only timeout."""
+
+    ARGS = tb.StructSpec(
+        "args",
+        None,
+        (tb.Field(1, "snapshot", ("map", tb.T_STRING, ("struct", tb.VALUE))),),
+    )
+    AREA_ARGS = tb.StructSpec(
+        "args",
+        None,
+        (
+            tb.Field(1, "area", tb.T_STRING),
+            tb.Field(
+                2, "snapshot", ("map", tb.T_STRING, ("struct", tb.VALUE))
+            ),
+        ),
+    )
+
+    @pytest.fixture
+    def shim(self):
+        from openr_tpu.kvstore import InProcessTransport
+        from openr_tpu.main import OpenrDaemon
+        from openr_tpu.serializer import dumps
+        from openr_tpu.spark import MockIoProvider
+        from tests.test_system import make_config
+
+        fabric = MockIoProvider()
+        daemon = OpenrDaemon(
+            make_config("lpd", ctrl_port=0),
+            io_provider=fabric.endpoint("lpd"),
+            kvstore_transport=InProcessTransport().bind("lpd"),
+        )
+        daemon.start()
+        shim = ThriftBinaryShim(
+            daemon.kvstore,
+            port=0,
+            node_name="lpd",
+            kvstore_updates_queue=daemon.kvstore_updates_queue,
+            long_poll_timeout_s=1.0,
+        )
+        shim.run()
+        # a real serialized AdjacencyDatabase so the daemon's own
+        # decision reader digests the injected key without complaint
+        adj_payload = dumps(
+            AdjacencyDatabase(
+                this_node_name="peerx", adjacencies=[], area="0"
+            )
+        )
+        daemon.kvstore.set_key_vals(
+            "0", {"adj:peerx": Value(1, "peerx", adj_payload, -1, 0)}
+        )
+        yield daemon, shim, adj_payload
+        shim.stop()
+        shim.wait_until_stopped(5)
+        daemon.stop()
+
+    def _current_snapshot(self, daemon):
+        pub = daemon.kvstore.dump_all("0", key_prefixes=["adj:"])
+        return {
+            k: Value(v.version, v.originator_id, None, -1, 0)
+            for k, v in pub.key_vals.items()
+        }
+
+    def test_stale_snapshot_resolves_immediately(self, shim):
+        daemon, shim_srv, _ = shim
+        import time
+
+        # deprecated area-less variant, empty snapshot: adj:peerx is news
+        t0 = time.monotonic()
+        changed = _call_ok(
+            shim_srv.port,
+            "longPollKvStoreAdj",
+            31,
+            tb.encode_struct(self.ARGS, {"snapshot": {}}),
+            tb.T_BOOL,
+        )
+        assert changed is True
+        # area variant with a wrong-version snapshot: also immediate
+        stale = {"adj:peerx": Value(99, "peerx", None, -1, 0)}
+        changed = _call_ok(
+            shim_srv.port,
+            "longPollKvStoreAdjArea",
+            32,
+            tb.encode_struct(
+                self.AREA_ARGS, {"area": "0", "snapshot": stale}
+            ),
+            tb.T_BOOL,
+        )
+        assert changed is True
+        assert time.monotonic() - t0 < 1.0  # neither call waited out
+
+    def test_current_snapshot_times_out_false(self, shim):
+        daemon, shim_srv, _ = shim
+        import threading
+        import time
+
+        snap = self._current_snapshot(daemon)
+        assert snap  # the injected adj key is in it
+        out = []
+        th = threading.Thread(
+            target=lambda: out.append(
+                _call_ok(
+                    shim_srv.port,
+                    "longPollKvStoreAdjArea",
+                    33,
+                    tb.encode_struct(
+                        self.AREA_ARGS, {"area": "0", "snapshot": snap}
+                    ),
+                    tb.T_BOOL,
+                )
+            )
+        )
+        t0 = time.monotonic()
+        th.start()
+        # a non-adj publication mid-poll must NOT resolve the poll
+        time.sleep(0.2)
+        daemon.kvstore.set_key_vals(
+            "0", {"snoop:noise": Value(1, "lpd", b"x", -1, 0)}
+        )
+        th.join(10)
+        assert not th.is_alive()
+        assert out == [False]
+        assert time.monotonic() - t0 >= 0.9  # waited out the full window
+
+    def test_adj_version_bump_triggers_mid_poll(self, shim):
+        daemon, shim_srv, adj_payload = shim
+        import threading
+        import time
+
+        snap = self._current_snapshot(daemon)
+        out = []
+        th = threading.Thread(
+            target=lambda: out.append(
+                _call_ok(
+                    shim_srv.port,
+                    "longPollKvStoreAdj",
+                    34,
+                    tb.encode_struct(self.ARGS, {"snapshot": snap}),
+                    tb.T_BOOL,
+                )
+            )
+        )
+        t0 = time.monotonic()
+        th.start()
+        time.sleep(0.2)
+        daemon.kvstore.set_key_vals(
+            "0", {"adj:peerx": Value(2, "peerx", adj_payload, -1, 0)}
+        )
+        th.join(10)
+        assert not th.is_alive()
+        assert out == [True]
+        assert time.monotonic() - t0 < 1.0  # resolved before the timeout
+
+
 class TestDaemonShimWiring:
     def test_daemon_starts_shim_from_config(self):
         """thrift_shim_port=-1 starts the interop listener with the
