@@ -1,13 +1,19 @@
-"""Static invariant checker for openr_tpu (stdlib-ast only, no jax import).
+"""Static invariant checker for openr_tpu.
 
-Three checker families — jit hygiene, thread discipline, counter hygiene —
-documented in docs/ARCHITECTURE.md ("Static invariants").  Run with
-``python -m openr_tpu.analysis openr_tpu/`` or ``scripts/lint.py``.
+Three AST checker families — jit hygiene, thread discipline, counter
+hygiene — are stdlib-ast only and never import jax.  The program-level
+family (``--programs``) is the exception: it imports jax to trace every
+jit root and residency-ladder cell to a jaxpr and audit donation, dtype,
+callback, constant-size and op-count contracts (analysis/programs.py).
+Documented in docs/ARCHITECTURE.md ("Static invariants" and
+"Program-level invariants").  Run with ``python -m openr_tpu.analysis
+openr_tpu/`` or ``scripts/lint.py``.
 """
 
 from .core import (  # noqa: F401
     ALL_RULES,
     AnalysisConfig,
+    AnalysisError,
     Finding,
     Reporter,
     Severity,

@@ -1,16 +1,68 @@
 """Command line front end: ``python -m openr_tpu.analysis [paths...]``.
 
-Exits nonzero when any unsuppressed finding remains, so it can gate CI.
+Exit codes gate CI precisely:
+
+- ``0`` — clean tree (no unsuppressed findings)
+- ``1`` — findings: the tree is dirty, the analyzer worked
+- ``2`` — the ANALYZER is broken or misused: bad paths, unreadable
+  config/budget files, a program-auditor driver or trace failure, git
+  unavailable for ``--changed-only``.  CI must treat 2 as infra failure,
+  not as "findings" — a broken analyzer silently passing as rc=1 would
+  hide the difference between "bugs found" and "nothing was checked".
+
+``--programs`` adds the program-level rule family (imports jax, traces
+every jit root + residency-ladder cell; see analysis/programs.py) on top
+of the AST rules.  ``--write-budgets`` regenerates the op-count budget
+file instead of reporting program-budget findings.
+
+``--changed-only`` restricts *reported* AST findings to files touched in
+the working tree (staged, unstaged or untracked, per ``git status``).
+Analysis still runs over the full target set — the jit fixpoint, counter
+cross-referencing and suppression audit are whole-tree properties, and
+scoping the *analysis* would fabricate false positives (a counter seeded
+in a changed file but bumped in an unchanged one).  Program rules are
+whole-program by construction, so their findings always survive the
+filter.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 from pathlib import Path
 
-from .core import ALL_RULES, load_config, run_analysis
+from .core import ALL_RULES, AnalysisError, load_config, run_analysis
+
+
+def _changed_files(root: Path) -> set[str]:
+    """Repo-relative posix paths of files touched in the working tree."""
+    try:
+        proc = subprocess.run(
+            ["git", "-C", str(root), "status", "--porcelain"],
+            capture_output=True,
+            text=True,
+            timeout=30,
+        )
+    except (OSError, subprocess.TimeoutExpired) as e:
+        raise AnalysisError(f"--changed-only needs git: {e}") from e
+    if proc.returncode != 0:
+        raise AnalysisError(
+            "--changed-only needs a git work tree: "
+            f"git status failed: {proc.stderr.strip()}"
+        )
+    changed: set[str] = set()
+    for line in proc.stdout.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:]
+        # renames report "old -> new"; the new path is the analyzable one
+        if " -> " in path:
+            path = path.split(" -> ", 1)[1]
+        path = path.strip().strip('"')
+        changed.add(Path(path).as_posix())
+    return changed
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -18,7 +70,8 @@ def main(argv: list[str] | None = None) -> int:
         prog="python -m openr_tpu.analysis",
         description=(
             "openr-tpu static invariant checker: jit hygiene, thread "
-            "discipline, counter hygiene"
+            "discipline, counter hygiene, and (with --programs) "
+            "program-level jaxpr contracts"
         ),
     )
     parser.add_argument(
@@ -38,6 +91,31 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--list-rules", action="store_true", help="list rule ids and exit"
     )
+    parser.add_argument(
+        "--programs",
+        action="store_true",
+        help=(
+            "also run the program-level auditor (imports jax; traces every "
+            "jit root and residency-ladder cell on CPU)"
+        ),
+    )
+    parser.add_argument(
+        "--write-budgets",
+        action="store_true",
+        help=(
+            "regenerate openr_tpu/analysis/program_budgets.json from the "
+            "measured op counts instead of reporting program-budget "
+            "findings (implies --programs)"
+        ),
+    )
+    parser.add_argument(
+        "--changed-only",
+        action="store_true",
+        help=(
+            "report AST findings only for files touched in the git working "
+            "tree; program-* findings are always whole-tree"
+        ),
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -54,9 +132,28 @@ def main(argv: list[str] | None = None) -> int:
         )
         return 2
 
-    config, root = load_config(targets[0])
-    reporter = run_analysis(targets, config, root)
+    programs = args.programs or args.write_budgets
+    try:
+        config, root = load_config(targets[0])
+        changed = _changed_files(root) if args.changed_only else None
+        reporter = run_analysis(
+            targets,
+            config,
+            root,
+            programs=programs,
+            write_budgets=args.write_budgets,
+        )
+    except AnalysisError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
     findings = reporter.sorted_findings()
+    if changed is not None:
+        findings = [
+            f
+            for f in findings
+            if f.rule.startswith("program-") or f.path in changed
+        ]
 
     if args.fmt == "json":
         print(

@@ -42,6 +42,12 @@ class Severity(enum.Enum):
         return self.value
 
 
+class AnalysisError(Exception):
+    """The analyzer itself could not run (bad config, git failure, trace
+    failure in the program auditor) — distinct from findings, so the CLI
+    can exit 2 ("broken analyzer") instead of 1 ("dirty tree")."""
+
+
 @dataclass(frozen=True)
 class Finding:
     """One diagnostic raised by a rule against a source location."""
@@ -68,21 +74,38 @@ _SUPPRESS_RE = re.compile(r"#\s*openr:\s*disable=([A-Za-z0-9_\-,\s]+)")
 
 
 @dataclass
+class SuppressionDecl:
+    """One ``# openr: disable=`` comment: the declaration line, the rules
+    it names, the code lines it covers, and which rules actually matched a
+    finding (feeds the suppression-unused rule)."""
+
+    line: int
+    rules: frozenset[str]
+    covered: set[int] = field(default_factory=set)
+    used_rules: set[str] = field(default_factory=set)
+
+
+@dataclass
 class Suppressions:
     """Per-file map of line -> set of suppressed rule ids ('all' wildcard)."""
 
     by_line: dict[int, set[str]] = field(default_factory=dict)
-    #: suppressions that never matched a finding; reported by --show-unused
+    #: one record per disable comment, for unused-suppression reporting
+    decls: list[SuppressionDecl] = field(default_factory=list)
+    #: suppressions that matched a finding, keyed (covered line, rule)
     used: set[tuple[int, str]] = field(default_factory=set)
 
     def matches(self, line: int, rule: str) -> bool:
         rules = self.by_line.get(line)
-        if not rules:
+        if not rules or (rule not in rules and "all" not in rules):
             return False
-        if rule in rules or "all" in rules:
-            self.used.add((line, rule))
-            return True
-        return False
+        self.used.add((line, rule))
+        for decl in self.decls:
+            if line in decl.covered and (
+                rule in decl.rules or "all" in decl.rules
+            ):
+                decl.used_rules.add(rule)
+        return True
 
 
 def collect_suppressions(source: str) -> Suppressions:
@@ -121,12 +144,17 @@ def collect_suppressions(source: str) -> Suppressions:
             continue
         rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
         line = tok.start[0]
+        covered = {line}
         sup.by_line.setdefault(line, set()).update(rules)
         if line not in code_lines:
             # Standalone comment: also cover the next code line.
             nxt = min((ln for ln in code_lines if ln > line), default=None)
             if nxt is not None:
                 sup.by_line.setdefault(nxt, set()).update(rules)
+                covered.add(nxt)
+        sup.decls.append(
+            SuppressionDecl(line=line, rules=frozenset(rules), covered=covered)
+        )
     return sup
 
 
@@ -157,7 +185,43 @@ ALL_RULES: dict[str, str] = {
         "counter bumped but unreachable from OpenrCtrlHandler._all_counters"
     ),
     "counter-duplicate": "one counter bumped under two spellings",
+    "counter-unbumped": (
+        "counter pre-seeded in a registry literal but never bumped anywhere"
+    ),
+    # lint of the lint (openr_tpu/analysis/core.py)
+    "suppression-unused": (
+        "'# openr: disable=' marker whose rule never fires on that line"
+    ),
+    # program-level invariants (openr_tpu/analysis/programs.py; these trace
+    # real jaxprs, so they only run under --programs / run_analysis(programs=True))
+    "program-donation": (
+        "donate_argnums declared but XLA does not alias the buffer "
+        "(donation silently dropped: aval mismatch between input and outputs)"
+    ),
+    "program-dtype": (
+        "float64 or weak-type float promotion inside a traced program"
+    ),
+    "program-callback": (
+        "host callback / debug primitive inside a compiled program"
+    ),
+    "program-constants": (
+        "large closed-over constant embedded in a compiled program "
+        "(re-uploaded on every compile)"
+    ),
+    "program-budget": (
+        "jaxpr primitive count exceeds the checked-in op-count budget"
+    ),
+    "program-coverage": (
+        "jit root discovered by the AST pass but never traced by the "
+        "program auditor's drivers"
+    ),
 }
+
+#: rules that require tracing real programs (jax import); they are executed
+#: only when run_analysis(..., programs=True) / the CLI --programs flag
+PROGRAM_RULES = frozenset(
+    r for r in ALL_RULES if r.startswith("program-")
+)
 
 
 @dataclass
@@ -183,6 +247,13 @@ class AnalysisConfig:
     counter_extra_prefixes: list[str] = field(default_factory=list)
     #: attribute names treated as module handles by the thread checker
     module_attrs: list[str] = field(default_factory=list)
+    #: program-constants threshold: closed-over consts above this many bytes
+    #: are flagged (they re-upload per compile instead of living in residency)
+    program_const_max_bytes: int = 4096
+    #: jit roots (bare function names) allowed to carry float dtypes in their
+    #: jaxpr (e.g. differentiable/loss kernels); everything else is integer
+    #: min-plus arithmetic and any float is a promotion bug
+    program_float_allowed: list[str] = field(default_factory=list)
 
     def active_rules(self) -> set[str]:
         return {r for r in self.enable if r in ALL_RULES} - set(self.disable)
@@ -233,6 +304,8 @@ def _parse_toml_minimal(text: str) -> dict[str, dict[str, object]]:
             section[key] = [a if a else b for a, b in items]
         elif val in ("true", "false"):
             section[key] = val == "true"
+        elif re.fullmatch(r"-?\d+", val.split("#")[0].strip()):
+            section[key] = int(val.split("#")[0].strip())
         else:
             m = re.match(r'"((?:[^"\\]|\\.)*)"|\'([^\']*)\'', val)
             if m:
@@ -273,10 +346,14 @@ def load_config(start: Path) -> tuple[AnalysisConfig, Path]:
                     "engine_dispatch_paths",
                     "counter_extra_prefixes",
                     "module_attrs",
+                    "program_float_allowed",
                 ):
                     val = raw.get(key)
                     if isinstance(val, list):
                         setattr(cfg, key, [str(v) for v in val])
+                val = raw.get("program_const_max_bytes")
+                if isinstance(val, int) and not isinstance(val, bool):
+                    cfg.program_const_max_bytes = val
             return cfg, candidate
     return AnalysisConfig(), cur
 
@@ -380,8 +457,19 @@ def run_analysis(
     targets: Sequence[Path],
     config: AnalysisConfig | None = None,
     root: Path | None = None,
+    *,
+    programs: bool = False,
+    write_budgets: bool = False,
 ) -> Reporter:
-    """Run every enabled checker family over `targets`; return the Reporter."""
+    """Run every enabled checker family over `targets`; return the Reporter.
+
+    ``programs=True`` additionally runs the program-level auditor
+    (openr_tpu/analysis/programs.py) — the only family that imports jax and
+    traces real jaxprs.  It always audits the whole tree (the jit root set
+    from ``jit_paths`` plus the residency-engine ladder), regardless of
+    `targets`.  ``write_budgets=True`` regenerates the op-count budget file
+    instead of reporting program-budget findings.
+    """
     if config is None or root is None:
         cfg, found_root = load_config(targets[0] if targets else Path.cwd())
         config = config or cfg
@@ -397,23 +485,83 @@ def run_analysis(
 
     reporter = Reporter(config)
     active = config.active_rules()
+    # Rules whose checker actually executed this run: a suppression for a
+    # rule that never ran (e.g. program-* in an AST-only pass) must not be
+    # reported unused.
+    executed: set[str] = set()
 
-    if active & {
+    jit_rules = {
         "jit-host-sync",
         "jit-tracer-branch",
         "jit-static-hygiene",
         "jit-dispatch-sync",
         "jit-unbucketed-dispatch",
-    }:
+    }
+    if active & jit_rules:
         from . import jit
 
         jit.check(files, reporter, config, root)
-    if active & {"thread-cross-module-write", "thread-queue-registration"}:
+        executed |= active & jit_rules
+    thread_rules = {"thread-cross-module-write", "thread-queue-registration"}
+    if active & thread_rules:
         from . import threads
 
         threads.check(files, reporter, config, root)
-    if active & {"counter-name", "counter-registry", "counter-duplicate"}:
+        executed |= active & thread_rules
+    counter_rules = {
+        "counter-name",
+        "counter-registry",
+        "counter-duplicate",
+        "counter-unbumped",
+    }
+    if active & counter_rules:
         from . import counters
 
         counters.check(files, reporter, config, root)
+        executed |= active & counter_rules
+    if programs and active & PROGRAM_RULES:
+        from . import programs as programs_mod
+
+        programs_mod.check(
+            files, reporter, config, root, write_budgets=write_budgets
+        )
+        executed |= active & PROGRAM_RULES
+
+    if "suppression-unused" in active:
+        executed.add("suppression-unused")
+        _check_unused_suppressions(files, reporter, executed)
     return reporter
+
+
+def _check_unused_suppressions(
+    files: list[SourceFile], reporter: Reporter, executed: set[str]
+) -> None:
+    """Lint of the lint: report disable markers whose rule was checked on
+    this run but never fired on the covered line(s)."""
+    for sf in files:
+        for decl in sf.suppressions.decls:
+            if "all" in decl.rules:
+                # wildcard: unused only when nothing at all matched it
+                if not decl.used_rules:
+                    reporter.emit(
+                        sf,
+                        "suppression-unused",
+                        (decl.line, 0),
+                        "'# openr: disable=all' suppresses nothing here; "
+                        "remove it (or name the intended rule)",
+                    )
+                continue
+            dead = sorted(
+                r
+                for r in decl.rules
+                if r in executed and r not in decl.used_rules
+            )
+            for rule in dead:
+                reporter.emit(
+                    sf,
+                    "suppression-unused",
+                    (decl.line, 0),
+                    f"suppression for '{rule}' is unused: the rule does not "
+                    "fire on this line; remove the marker (stale "
+                    "suppressions hide future regressions)",
+                )

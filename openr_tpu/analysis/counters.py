@@ -18,6 +18,16 @@ registry.  Three rules keep every bump site on that path:
   Spellings are compared after normalizing a leading ``num_`` on each
   segment (``queue.x.num_overflows`` vs ``queue.x.overflows`` collide).
 
+- ``counter-unbumped`` (the inverse direction): a counter *pre-seeded* in
+  a registry literal — ``self.counters = {"mod.key": 0, ...}`` or the
+  ``{k: 0 for k in MODULE_KEYS}`` comprehension over a module-level tuple
+  of literals (the ``ENGINE_COUNTER_KEYS`` pattern) — that is never bumped
+  anywhere in the analyzed tree.  A seeded-but-dead counter reads as a
+  permanent zero on the operator surface, which is worse than absent: it
+  asserts "this event never happens" while nothing measures it.  Only
+  convention-clean (``module.name``) seeds are checked; bare-keyed mock
+  surfaces are out of scope.
+
 Bump sites recognized: ``*. _bump("lit", ...)`` calls and subscript
 writes into counters-like dicts (``...counters["lit"] = / +=``).  The
 ``stats()`` dict literals in ``runtime/queue.py`` are treated as synthetic
@@ -108,6 +118,22 @@ def check(
                     "counter onto an exported surface",
                 )
 
+    # seeded-but-never-bumped registry keys (inverse hygiene).  Seeds are
+    # matched against every bump literal in the analyzed file set, so the
+    # check is tree-wide when run over the package.
+    bumped_literals = {s.literal for s in sites if not s.synthetic}
+    for sf in files:
+        for literal, node in _collect_seeds(sf):
+            if literal not in bumped_literals:
+                reporter.emit(
+                    sf,
+                    "counter-unbumped",
+                    node,
+                    f"counter '{literal}' is pre-seeded in a registry but "
+                    "never bumped anywhere; it reads as a permanent zero on "
+                    "the operator surface — bump it or drop the seed",
+                )
+
     # duplicate spellings
     by_norm: dict[str, dict[str, list[BumpSite]]] = defaultdict(
         lambda: defaultdict(list)
@@ -169,6 +195,53 @@ def _collect_bumps(sf: SourceFile) -> list[BumpSite]:
                 sl = tgt.slice
                 if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
                     out.append(BumpSite(sl.value, sf, sl))
+    return out
+
+
+def _collect_seeds(sf: SourceFile) -> list[tuple[str, ast.AST]]:
+    """Registry seeds: convention-clean string keys of dict literals (or of
+    ``{k: 0 for k in KEYS}`` comprehensions over module-level literal
+    tuples) assigned to counters-like targets."""
+    # module-level NAME = ("lit", ...) tuples, for the comprehension form
+    mod_tuples: dict[str, list[ast.Constant]] = {}
+    for node in sf.tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, (ast.Tuple, ast.List))
+            and node.value.elts
+            and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in node.value.elts
+            )
+        ):
+            mod_tuples[node.targets[0].id] = list(node.value.elts)
+
+    out: list[tuple[str, ast.AST]] = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        if not any(_is_counters_dict(t) for t in targets):
+            continue
+        if isinstance(value, ast.Dict):
+            for k in value.keys:
+                if (
+                    isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                    and _NAME_RE.fullmatch(k.value)
+                ):
+                    out.append((k.value, k))
+        elif isinstance(value, ast.DictComp) and value.generators:
+            it = value.generators[0].iter
+            if isinstance(it, ast.Name) and it.id in mod_tuples:
+                for e in mod_tuples[it.id]:
+                    if _NAME_RE.fullmatch(e.value):
+                        out.append((e.value, e))
     return out
 
 

@@ -372,9 +372,7 @@ class FleetRouteView:
             maps=maps,
         )
         # `ok` is a host bool by reduced_all_sources' contract (fetched
-        # inside, fused with the block-counter read); the checker cannot
-        # see through the tuple return
-        # openr: disable=jit-dispatch-sync
+        # inside, fused with the block-counter read)
         if not ok and init is not None:
             # the warm relax exhausted its block budget without the
             # on-device certificate: the seed bought nothing — pay the
@@ -392,7 +390,7 @@ class FleetRouteView:
                 self.csr.node_overloaded,
                 maps=maps,
             )
-        # host bool per the same contract  # openr: disable=jit-dispatch-sync
+        # host bool per the same contract
         assert ok, "fleet reverse SSSP did not reach its fixed point"
         self._dist_dev = dist
         self._bitmap_dev = bitmap

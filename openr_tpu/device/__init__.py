@@ -12,9 +12,12 @@ from .engine import (
     ENGINE_COUNTER_KEYS,
     S_BUCKETS,
 )
+from .sanitizer import EngineSanitizer, SanitizerViolation
 
 __all__ = [
     "DeviceResidencyEngine",
     "ENGINE_COUNTER_KEYS",
+    "EngineSanitizer",
     "S_BUCKETS",
+    "SanitizerViolation",
 ]

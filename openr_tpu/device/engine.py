@@ -29,10 +29,8 @@ oracle); the chaos harness injects faults through `fault_hook`.
 
 from __future__ import annotations
 
-import contextlib
 import functools
 import time
-import warnings
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
@@ -60,17 +58,6 @@ ENGINE_COUNTER_KEYS = (
     "device.engine.compile_us",
     "device.engine.dispatch_us",
 )
-
-
-@contextlib.contextmanager
-def _quiet_donation():
-    """CPU backends can't always honor donation and warn per trace;
-    the request is still correct (and honored) on device backends."""
-    with warnings.catch_warnings():
-        warnings.filterwarnings(
-            "ignore", message="Some donated buffers were not usable"
-        )
-        yield
 
 
 def _s_bucket(s: int) -> int:
@@ -169,7 +156,11 @@ def _forward_body(
         )
         ok = dist_ok & nh_ok
         if not small:
-            return dist_old_T.T, dag_T.T, nh, ok
+            # dist stays in the donated [N_cap, S] layout: the output aval
+            # must equal the donated input's for XLA to alias the buffer
+            # (a transposed return silently drops the donation); the host
+            # transposes the fetched view for free after device_get
+            return dist_old_T, dag_T.T, nh, ok
         # small control-plane query: ONE packed device->host transfer
         return jnp.concatenate(
             [
@@ -216,13 +207,22 @@ class DeviceResidencyEngine:
         self,
         max_programs: int = 16,
         s_buckets: tuple = S_BUCKETS,
+        small_threshold: int = 1 << 21,
     ) -> None:
         self.max_programs = max_programs
         self.s_buckets = tuple(s_buckets)
+        # S_bucket * node_capacity at or below this dispatches the packed
+        # single-transfer program shape; the program auditor forces it to 0
+        # to exercise the full (donation-aliased) shape on tiny topologies
+        self.small_threshold = small_threshold
         self.counters: dict[str, int] = {k: 0 for k in ENGINE_COUNTER_KEYS}
         # (topo_key, s_bucket, n_words, n_sweeps, small, use_link_metric)
         #   -> AOT-compiled executable; OrderedDict as LRU
         self._programs: "OrderedDict[tuple, Any]" = OrderedDict()
+        # key -> (program body fn, arg ShapeDtypeStructs, donate_argnums):
+        # enough for the program auditor to re-trace every ladder cell it
+        # saw compiled, without holding example arrays alive
+        self._program_specs: dict[tuple, tuple] = {}
         # id(csr) -> _Resident (csr mirrors are long-lived per area)
         self._residents: dict[int, _Resident] = {}
         # chaos seam: called with an op name at every engine entry point
@@ -332,8 +332,11 @@ class DeviceResidencyEngine:
             idx, vals = _pad_updates(
                 idx, vals, pad_val=vals.dtype.type(0)
             )
-            with _quiet_donation():
-                setattr(res, attr, write(getattr(res, attr), idx, vals))
+            # explicit H2D staging: the masked-write programs must never
+            # see raw host arrays (the transfer-guard sanitizer disallows
+            # implicit transfers on every engine dispatch path)
+            idx_dev, vals_dev = jax.device_put((idx, vals))
+            setattr(res, attr, write(getattr(res, attr), idx_dev, vals_dev))
             staged += _nbytes(idx, vals)
             shadow[changed] = host[changed]
         res.version = csr.version
@@ -360,14 +363,26 @@ class DeviceResidencyEngine:
         t0 = time.perf_counter()
         _topo, _sb, n_words, n_sweeps, small, use_link_metric = key
         fn = _forward_body(small, use_link_metric, n_sweeps, n_words)
+        # The packed (small) shape concatenates everything into one 1-D
+        # int32 vector, so no output can alias the [N_cap, S] scratch —
+        # requesting donation there would be silently dropped.  The full
+        # shape returns dist in the donated layout and is aliased.
+        donate = () if small else (0,)
         # AOT: lower+compile now so the jit cache never owns the
         # executable — LRU eviction below genuinely frees it
-        with _quiet_donation():
-            compiled = (
-                jax.jit(fn, donate_argnums=(0,))
-                .lower(*example_args)
-                .compile()
-            )
+        compiled = (
+            jax.jit(fn, donate_argnums=donate)
+            .lower(*example_args)
+            .compile()
+        )
+        self._program_specs[key] = (
+            fn,
+            jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                example_args,
+            ),
+            donate,
+        )
         self._bump("device.engine.compiles")
         self._bump(
             "device.engine.compile_us",
@@ -408,7 +423,7 @@ class DeviceResidencyEngine:
         # stable across source sets; unset high words decode to no bits
         n_words = max(1, -(-csr.max_out_slots // 32))
         n_cap = csr.node_capacity
-        small = s_bucket * n_cap <= (1 << 21)
+        small = s_bucket * n_cap <= self.small_threshold
 
         t0 = time.perf_counter()
         while True:
@@ -439,12 +454,15 @@ class DeviceResidencyEngine:
             )
             compiled = self._program(key, args)
             out = compiled(*args)
+            # every fetch below is an explicit device_get: the engine's
+            # dispatch paths run under the transfer-guard sanitizer, which
+            # disallows implicit host round-trips
             if small:
-                packed = np.asarray(out)
+                packed = jax.device_get(out)
                 converged = packed[-1] == 1
             else:
                 dist_j, dag_j, nh_j, ok_j = out
-                converged = bool(ok_j)
+                converged = bool(jax.device_get(ok_j))
             if converged:
                 break
             res.sweep_hint = n_sweeps * 2
@@ -466,9 +484,10 @@ class DeviceResidencyEngine:
                 .reshape(s_bucket, n_cap, n_words)
             )
         else:
-            dist = np.asarray(dist_j)
-            dag = np.asarray(dag_j)
-            nh = np.asarray(nh_j)
+            # one batched fetch; dist comes back in the donated [N_cap, S]
+            # layout (see _forward_body) and is transposed host-side
+            dist_T, dag, nh = jax.device_get((dist_j, dag_j, nh_j))
+            dist = dist_T.T
         self._bump(
             "device.engine.dispatch_us",
             int((time.perf_counter() - t0) * 1e6),

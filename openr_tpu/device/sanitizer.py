@@ -1,0 +1,93 @@
+"""Runtime sanitizer for engine dispatch paths.
+
+The static program auditor (analysis/programs.py) proves contracts of the
+*compiled* programs; this module polices the *dispatch* that feeds them,
+at test time:
+
+- :meth:`EngineSanitizer.transfer_guard` wraps a dispatch in
+  ``jax.transfer_guard("disallow")``: any IMPLICIT host->device transfer
+  (a numpy array or Python scalar leaking straight into a compiled call
+  instead of going through the engine's explicit, accounted
+  ``jax.device_put`` staging) surfaces as :class:`SanitizerViolation`.
+  Explicit ``device_put`` / ``device_get`` remain allowed — they are the
+  engine's sanctioned, byte-counted staging path.
+
+  CPU-CI caveat: on CPU, device->host reads (``np.asarray`` on a device
+  array, ``device_get``) are zero-copy and are NOT flagged by the guard;
+  only the implicit host->device direction is enforced here.  On real
+  accelerators the same guard also catches stray D2H syncs.
+
+- :meth:`EngineSanitizer.compile_budget` asserts the engine compiles at
+  most ``allowed`` new programs inside the block (default 0): after
+  warmup, a steady-state query must be a bucket hit.  A recompile in the
+  hot loop means the bucket key leaked per-query state (a fresh sweep
+  hint, an unpadded source count) — the exact regression the AOT ladder
+  exists to prevent.
+
+Used by tier-1 (tests/test_sanitizer.py wires both checks around real
+engine queries and proves each catches a seeded violation; the 25-flap
+acceptance sequence runs its warm queries under the transfer guard).
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Iterator
+
+__all__ = ["EngineSanitizer", "SanitizerViolation"]
+
+_GUARD_MARKER = "Disallowed host-to-device transfer"
+
+COMPILES_KEY = "device.engine.compiles"
+
+
+class SanitizerViolation(AssertionError):
+    """An engine dispatch broke a runtime residency contract."""
+
+
+class EngineSanitizer:
+    """Wraps a :class:`DeviceResidencyEngine`'s dispatches in runtime
+    contract checks.  Stateless between blocks; cheap to construct."""
+
+    def __init__(self, engine) -> None:
+        self.engine = engine
+
+    @contextmanager
+    def transfer_guard(self) -> Iterator[None]:
+        """Fail the block on any implicit host->device transfer."""
+        import jax
+
+        try:
+            with jax.transfer_guard("disallow"):
+                yield
+        except Exception as e:
+            if _GUARD_MARKER in str(e):
+                raise SanitizerViolation(
+                    "implicit host->device transfer inside an engine "
+                    "dispatch — a host array reached a compiled program "
+                    "without going through the engine's explicit "
+                    f"device_put staging: {e}"
+                ) from e
+            raise
+
+    @contextmanager
+    def compile_budget(self, allowed: int = 0) -> Iterator[None]:
+        """Fail the block if the engine compiles more than ``allowed``
+        new programs (default: none — steady state is all bucket hits)."""
+        before = self.engine.get_counters()[COMPILES_KEY]
+        yield
+        spent = self.engine.get_counters()[COMPILES_KEY] - before
+        if spent > allowed:
+            raise SanitizerViolation(
+                f"engine compiled {spent} program(s) inside a "
+                f"compile_budget({allowed}) block; a steady-state query "
+                "must hit the AOT bucket cache — check that the bucket "
+                "key doesn't include per-query state"
+            )
+
+    @contextmanager
+    def sanitized(self, allowed_compiles: int = 0) -> Iterator[None]:
+        """Both checks at once: the steady-state dispatch contract."""
+        with self.transfer_guard():
+            with self.compile_budget(allowed_compiles):
+                yield

@@ -561,7 +561,6 @@ def reduced_all_sources(
     # fused path above fetches it with device_get), so callers can branch
     # on it without paying another sync.  On the adaptive path the scalar
     # was already realized by attempt(); this bool() is a cached read.
-    # openr: disable=jit-dispatch-sync
     return dist, bitmap, bool(ok)
 
 

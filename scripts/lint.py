@@ -2,7 +2,10 @@
 """Run the openr-tpu static invariant checker (see docs/ARCHITECTURE.md).
 
 Equivalent to ``python -m openr_tpu.analysis openr_tpu/`` from the repo
-root, but runnable from anywhere in the tree.
+root, but runnable from anywhere in the tree.  All CLI flags pass
+through — e.g. ``scripts/lint.py --changed-only`` for a fast pre-commit
+pass scoped to the files you touched, or ``scripts/lint.py --programs``
+for the full jaxpr-contract audit.
 """
 
 import sys
@@ -14,5 +17,9 @@ sys.path.insert(0, str(REPO_ROOT))
 from openr_tpu.analysis.cli import main  # noqa: E402
 
 if __name__ == "__main__":
-    argv = sys.argv[1:] or [str(REPO_ROOT / "openr_tpu")]
+    argv = sys.argv[1:]
+    # default target only when no positional path was given (flags pass
+    # through untouched)
+    if not any(not a.startswith("-") for a in argv):
+        argv = argv + [str(REPO_ROOT / "openr_tpu")]
     sys.exit(main(argv))

@@ -1,0 +1,36 @@
+"""Seeded ``counter-unbumped`` violations (inverse counter hygiene).
+
+Both seed forms the rule recognizes, each with a bumped (clean) and a
+never-bumped (flagged) member, plus a rationale-suppressed seed:
+
+- dict-literal registry: ``self.counters = {"lit": 0, ...}``
+- comprehension over a module-level literal tuple (the engine's
+  ``ENGINE_COUNTER_KEYS`` pattern)
+
+Line numbers are asserted exactly by tests/test_analysis.py — keep the
+layout stable.
+"""
+
+MODULE_KEYS = (
+    "fib.sync_ok",
+    "fib.sync_retries",
+)
+
+
+class Registry:
+    def __init__(self):
+        self.counters = {
+            "kvstore.sent": 0,
+            "kvstore.dropped": 0,
+            # reserved for the next protocol rev; seeded so dashboards
+            # pre-create the series
+            "kvstore.reserved": 0,  # openr: disable=counter-unbumped
+        }
+        self.comp_counters = {k: 0 for k in MODULE_KEYS}
+
+    def _bump(self, key, n=1):
+        self.counters[key] = self.counters.get(key, 0) + n
+
+    def run(self):
+        self._bump("kvstore.sent")
+        self._bump("fib.sync_ok")

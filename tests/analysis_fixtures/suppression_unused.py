@@ -1,0 +1,28 @@
+"""Seeded ``suppression-unused`` cases (dead-marker detection).
+
+Four declarations:
+
+- a USED marker (real violation on the line): stays silent, finding
+  lands in the suppressed list;
+- a DEAD marker (clean line): flagged suppression-unused;
+- a multi-rule marker where only one rule fires: the idle rule is
+  flagged, the firing one is not;
+- a marker for a program-* rule: must NOT be flagged by an AST-only run
+  (the program family did not execute, so the rule had no chance to
+  fire).
+
+Line numbers are asserted exactly by tests/test_analysis.py.
+"""
+
+
+class Module:
+    def _bump(self, key, n=1):
+        pass
+
+    def run(self):
+        # legacy spelling kept for dashboard continuity
+        self._bump("BadSpelling")  # openr: disable=counter-name
+        self._bump("kvstore.ok")  # openr: disable=counter-name
+        self._bump("AlsoBad")  # openr: disable=counter-name,counter-registry
+        # openr: disable=program-dtype
+        self._bump("fib.converged")

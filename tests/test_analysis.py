@@ -165,6 +165,100 @@ class TestCounterRules:
         ]
 
 
+class TestCounterUnbumpedRule:
+    """Inverse counter hygiene: a seeded-but-never-bumped registry key
+    reads as a permanent zero on the operator surface."""
+
+    def test_seeded_violations_by_rule_and_line(self):
+        # 16: dead member of the module-tuple comprehension seed,
+        # 24: dead key of the dict-literal seed; the bumped members of
+        # both forms stay silent
+        rep = _fixture_findings("counter_unbumped.py")
+        assert _pairs(rep) == [
+            ("counter-unbumped", 16),
+            ("counter-unbumped", 24),
+        ]
+
+    def test_rationale_suppression_is_honored(self):
+        rep = _fixture_findings("counter_unbumped.py")
+        assert [(s.rule, s.line) for s in rep.suppressed] == [
+            ("counter-unbumped", 27)
+        ]
+
+
+class TestSuppressionUnusedRule:
+    """Dead-marker detection: a '# openr: disable=' declaration whose
+    rule never fires on the covered lines is itself a finding."""
+
+    def test_dead_and_idle_markers_flagged(self):
+        # 25: marker on a clean line; 26: the idle half of a multi-rule
+        # marker (counter-name fires there, counter-registry never does)
+        rep = _fixture_findings("suppression_unused.py")
+        assert _pairs(rep) == [
+            ("suppression-unused", 25),
+            ("suppression-unused", 26),
+        ]
+
+    def test_used_markers_stay_silent(self):
+        rep = _fixture_findings("suppression_unused.py")
+        assert [(s.rule, s.line) for s in rep.suppressed] == [
+            ("counter-name", 24),
+            ("counter-name", 26),
+        ]
+
+    def test_program_rule_markers_exempt_in_ast_only_runs(self):
+        # the program-dtype marker (line 28) had no chance to fire in an
+        # AST-only pass; flagging it would train people to delete
+        # suppressions the --programs run still needs
+        rep = _fixture_findings("suppression_unused.py")
+        assert all(f.line != 28 for f in rep.findings)
+
+
+class TestChangedOnly:
+    """--changed-only reports AST findings only for files git sees as
+    touched; analysis still runs whole-tree (cross-file rules), and
+    program-* findings always survive the filter."""
+
+    def test_filter_scopes_ast_findings(self, monkeypatch, capsys):
+        from openr_tpu.analysis import cli
+
+        fixture = str(FIXTURES / "counter_violations.py")
+        monkeypatch.setattr(
+            cli, "_changed_files", lambda root: {"some/other_file.py"}
+        )
+        assert cli.main([fixture, "--changed-only"]) == 0
+        monkeypatch.setattr(
+            cli,
+            "_changed_files",
+            lambda root: {"tests/analysis_fixtures/counter_violations.py"},
+        )
+        assert cli.main([fixture, "--changed-only"]) == 1
+
+    def test_git_failure_is_exit_2(self, tmp_path):
+        """Outside a git work tree the flag is a config error (rc 2),
+        never a silent 'no changes -> clean' pass."""
+        target = tmp_path / "probe.py"
+        target.write_text("x = 1\n")
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "openr_tpu.analysis",
+                "probe.py",
+                "--changed-only",
+            ],
+            cwd=tmp_path,
+            capture_output=True,
+            text=True,
+            env={
+                **__import__("os").environ,
+                "PYTHONPATH": str(REPO_ROOT),
+            },
+        )
+        assert proc.returncode == 2, proc.stdout + proc.stderr
+        assert "--changed-only needs" in proc.stderr
+
+
 class TestTreeIsClean:
     def test_package_has_zero_unsuppressed_findings(self):
         """The acceptance gate: `python -m openr_tpu.analysis openr_tpu/`

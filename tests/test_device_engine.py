@@ -26,6 +26,7 @@ from openr_tpu.device import (
     ENGINE_COUNTER_KEYS,
     S_BUCKETS,
     DeviceResidencyEngine,
+    EngineSanitizer,
 )
 from openr_tpu.utils.topo import grid_topology
 
@@ -94,6 +95,11 @@ class TestTwentyFiveFlapSequence:
         initial_bytes = c["device.engine.bytes_staged"]
         assert initial_bytes > 0
 
+        # every post-warmup dispatch runs under the transfer sanitizer:
+        # all host->device traffic in the flap loop must go through the
+        # engine's explicit device_put staging (sanitizer.py; compiles
+        # are legitimate here — the bucket rotation forces evictions)
+        san = EngineSanitizer(engine)
         attribution = []  # (flap index, staged bytes, query us)
         for i, (db, kind, lnk, val) in enumerate(_flap_script(dbs)):
             if kind == "metric":
@@ -108,7 +114,8 @@ class TestTwentyFiveFlapSequence:
             size = (1, 5, 25)[i % 3]
             start = i % len(names)
             sources = (names + names)[start : start + size]
-            _assert_oracle(engine, csr, ls, sources)
+            with san.transfer_guard():
+                _assert_oracle(engine, csr, ls, sources)
             attribution.append(
                 (i, engine.last_query_bytes, engine.last_query_us)
             )

@@ -1,0 +1,278 @@
+"""Tier-1 gate for the program-level invariant auditor
+(openr_tpu/analysis/programs.py).
+
+Three halves:
+
+- the TREE is clean: the full ``--programs`` audit (every jit root in
+  jit_paths + device/engine.py, plus every residency-ladder cell, traced
+  on CPU against donation / dtype / callback / constant / op-count
+  contracts) reports zero findings and zero coverage gaps.  This is the
+  expensive half (~35 s: it compiles the fleet and the engine ladder
+  cold) and runs exactly once per module;
+- the AUDITOR is correct: each program rule catches a seeded violation
+  built from a deliberately broken function (dropped donation, weak
+  float promotion, host callback, oversized closed-over constant,
+  blown budget);
+- the fused fleet product's jaxpr matches a golden per-primitive
+  snapshot — a graph-structure change (new gather, extra while-loop,
+  lost fusion) fails with a readable per-primitive diff, not a bare
+  count.  Regenerate tests/golden/fused_product_jaxpr.json with
+  ``python -m openr_tpu.analysis --programs --write-budgets`` review +
+  the snippet in TestGoldenJaxpr's docstring after an intentional
+  kernel change.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from openr_tpu.analysis import (
+    AnalysisConfig,
+    AnalysisError,
+    Reporter,
+    load_config,
+    run_analysis,
+)
+from openr_tpu.analysis import programs as P
+from openr_tpu.analysis.core import SourceFile
+
+pytestmark = pytest.mark.analysis
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+PACKAGE = REPO_ROOT / "openr_tpu"
+GOLDEN = REPO_ROOT / "tests" / "golden" / "fused_product_jaxpr.json"
+FUSED_KEY = ("openr_tpu.ops.allsources", "_fused_progressive_banded")
+
+
+@pytest.fixture(scope="module")
+def audit():
+    """One full program audit for the whole module (the expensive half)."""
+    config, root = load_config(PACKAGE)
+    return run_analysis([PACKAGE], config, root, programs=True)
+
+
+@pytest.fixture()
+def harness():
+    """(reporter, audit, sf, loc) wired to a real SourceFile so seeded
+    jaxprs can be checked in isolation."""
+    config, root = load_config(PACKAGE)
+    reporter = Reporter(config)
+    sf = SourceFile.parse(PACKAGE / "analysis" / "programs.py", root)
+    return reporter, P._ProgramAudit(reporter, config, root), sf, (1, 0)
+
+
+def _rules(reporter):
+    return sorted(f.rule for f in reporter.findings)
+
+
+class TestTreeIsProgramClean:
+    def test_zero_findings_full_audit(self, audit):
+        """The acceptance gate: every root traced, every contract holds.
+        A coverage gap (a root no driver reaches) fails here too."""
+        findings = audit.sorted_findings()
+        assert not findings, "\n" + "\n".join(f.format() for f in findings)
+
+    def test_budget_file_covers_every_program(self):
+        budgets = json.loads(
+            (PACKAGE / "analysis" / "program_budgets.json").read_text()
+        )
+        assert len(budgets) >= 25
+        assert all(isinstance(v, int) and v > 0 for v in budgets.values())
+        # both halves of the audit are budgeted: ops roots and ladder cells
+        assert any(k.startswith("openr_tpu.ops.") for k in budgets)
+        assert any(k.startswith("device.engine._forward_body[") for k in budgets)
+
+
+class TestSeededViolations:
+    def test_dropped_donation_is_caught(self, harness):
+        """A transposed output can't alias the donated input; jax drops
+        the donation silently (warning only) — the auditor must flag it."""
+        reporter, audit, sf, loc = harness
+
+        def transposes(a):
+            return a.T
+
+        spec = jax.ShapeDtypeStruct((8, 4), jnp.int32)
+        audit.check_donation(sf, loc, "seed", transposes, (spec,), (0,))
+        assert _rules(reporter) == ["program-donation"]
+
+    def test_honored_donation_stays_silent(self, harness):
+        reporter, audit, sf, loc = harness
+
+        def keeps_layout(a):
+            return a + 1
+
+        spec = jax.ShapeDtypeStruct((8, 4), jnp.int32)
+        audit.check_donation(sf, loc, "seed", keeps_layout, (spec,), (0,))
+        assert _rules(reporter) == []
+
+    def test_weak_float_promotion_is_caught(self, harness):
+        reporter, audit, sf, loc = harness
+
+        def promotes(x):
+            return x * 2.5  # Python float -> weak f32 promotion
+
+        closed = jax.jit(promotes).trace(
+            jax.ShapeDtypeStruct((4,), jnp.float32)
+        ).jaxpr
+        audit.check_jaxpr(sf, loc, "seed", "promotes", closed)
+        assert "program-dtype" in _rules(reporter)
+
+    def test_float_allowlist_spares_loss_kernels(self, harness):
+        reporter, audit, sf, loc = harness
+        audit.config.program_float_allowed = ["blessed"]
+
+        def blessed(x):
+            return x * jnp.float32(2.5)
+
+        closed = jax.jit(blessed).trace(
+            jax.ShapeDtypeStruct((4,), jnp.float32)
+        ).jaxpr
+        audit.check_jaxpr(sf, loc, "seed", "blessed", closed)
+        assert _rules(reporter) == []
+
+    def test_host_callback_is_caught(self, harness):
+        reporter, audit, sf, loc = harness
+
+        def chatty(x):
+            jax.debug.print("x = {}", x)
+            return x + 1
+
+        closed = jax.jit(chatty).trace(
+            jax.ShapeDtypeStruct((4,), jnp.int32)
+        ).jaxpr
+        audit.check_jaxpr(sf, loc, "seed", "chatty", closed)
+        assert "program-callback" in _rules(reporter)
+
+    def test_large_closed_over_constant_is_caught(self, harness):
+        reporter, audit, sf, loc = harness
+        embedded = jnp.asarray(np.arange(4096, dtype=np.int32))  # 16 KiB
+
+        def closes_over(x):
+            return x + embedded
+
+        closed = jax.jit(closes_over).trace(
+            jax.ShapeDtypeStruct((4096,), jnp.int32)
+        ).jaxpr
+        audit.check_jaxpr(sf, loc, "seed", "closes_over", closed)
+        assert "program-constants" in _rules(reporter)
+
+    def test_integer_min_plus_program_stays_silent(self, harness):
+        reporter, audit, sf, loc = harness
+
+        def relax(d, m):
+            return jnp.minimum(d, d + m)
+
+        closed = jax.jit(relax).trace(
+            jax.ShapeDtypeStruct((8,), jnp.int32),
+            jax.ShapeDtypeStruct((8,), jnp.int32),
+        ).jaxpr
+        audit.check_jaxpr(sf, loc, "seed", "relax", closed)
+        assert _rules(reporter) == []
+
+
+class TestBudgetMachinery:
+    def test_corrupt_budget_file_is_analyzer_error(self, tmp_path):
+        bad = tmp_path / "program_budgets.json"
+        bad.write_text("{not json")
+        with pytest.raises(AnalysisError, match="unreadable budget file"):
+            P._load_budgets(bad)
+        bad.write_text("[1, 2]")
+        with pytest.raises(AnalysisError, match="JSON object"):
+            P._load_budgets(bad)
+
+    def test_missing_budget_file_means_no_budgets(self, tmp_path):
+        assert P._load_budgets(tmp_path / "absent.json") == {}
+
+    def test_analyzer_errors_exit_2_findings_exit_1(self, monkeypatch):
+        """The CLI's split: a broken auditor (driver/trace/config failure)
+        is rc 2, a dirty tree is rc 1 — CI must not confuse the two."""
+        from openr_tpu.analysis import cli
+
+        def boom(*a, **kw):
+            raise AnalysisError("program auditor driver 'x' failed")
+
+        monkeypatch.setattr(cli, "run_analysis", boom)
+        assert cli.main(["openr_tpu", "--programs"]) == 2
+
+        fixture = str(
+            REPO_ROOT / "tests" / "analysis_fixtures" / "counter_violations.py"
+        )
+        monkeypatch.undo()
+        assert cli.main([fixture]) == 1
+
+
+class TestGoldenJaxpr:
+    """Golden per-primitive snapshot of the fused fleet product.
+
+    Regenerate after an intentional kernel change::
+
+        python - <<'PY'
+        import json, jax
+        from openr_tpu.analysis import programs as P
+        jax.clear_caches()
+        rec = P._Recorder()
+        undo, orig = P._patch_roots(
+            {("openr_tpu.ops.allsources", "_fused_progressive_banded"): None},
+            rec,
+        )
+        try:
+            P._drive_fleet_ring({})
+        finally:
+            for m, a, o in undo:
+                setattr(m, a, o)
+        args, kwargs = rec.specs[
+            ("openr_tpu.ops.allsources", "_fused_progressive_banded")
+        ][0]
+        t = orig[
+            ("openr_tpu.ops.allsources", "_fused_progressive_banded")
+        ].trace(*args, **kwargs)
+        c = {}
+        for j in P._all_jaxprs(t.jaxpr.jaxpr):
+            for e in j.eqns:
+                c[e.primitive.name] = c.get(e.primitive.name, 0) + 1
+        print(json.dumps(dict(sorted(c.items())), indent=2))
+        PY
+    """
+
+    def test_fused_product_matches_golden(self):
+        jax.clear_caches()  # inner roots must re-trace (see programs.check)
+        recorder = P._Recorder()
+        undo, originals = P._patch_roots({FUSED_KEY: None}, recorder)
+        try:
+            P._drive_fleet_ring({})
+        finally:
+            for mod, attr, orig in undo:
+                setattr(mod, attr, orig)
+        assert recorder.specs.get(FUSED_KEY), (
+            "the ring fleet driver no longer dispatches the fused product"
+        )
+        # first captured spec == the cold 64-ring build (driver order is
+        # deterministic); warm variants carry extra init args
+        args, kwargs = recorder.specs[FUSED_KEY][0]
+        traced = originals[FUSED_KEY].trace(*args, **kwargs)
+        got: dict[str, int] = {}
+        for j in P._all_jaxprs(traced.jaxpr.jaxpr):
+            for e in j.eqns:
+                got[e.primitive.name] = got.get(e.primitive.name, 0) + 1
+
+        golden = json.loads(GOLDEN.read_text())
+        if got != golden:
+            lines = []
+            for prim in sorted(set(golden) | set(got)):
+                g, n = golden.get(prim, 0), got.get(prim, 0)
+                if g != n:
+                    lines.append(f"  {prim}: golden={g} got={n} ({n - g:+d})")
+            pytest.fail(
+                "fused-product jaxpr drifted from the golden snapshot "
+                f"(total {sum(golden.values())} -> {sum(got.values())}):\n"
+                + "\n".join(lines)
+                + "\nIf intentional, regenerate the snapshot (class "
+                "docstring) and justify the graph change in the PR."
+            )

@@ -1,0 +1,142 @@
+"""Runtime dispatch sanitizer (openr_tpu/device/sanitizer.py).
+
+Three properties, each proven in both directions:
+
+- the engine's REAL dispatch paths (cold compile aside) are clean under
+  ``jax.transfer_guard("disallow")`` — every host array reaches the
+  device through the engine's explicit, byte-accounted ``device_put``
+  staging, including the incremental masked-write sync;
+- the guard CATCHES a seeded violation: removing the explicit staging
+  (patching ``device_put`` to the identity, the exact refactor accident
+  the sanitizer exists for) makes a host array hit a compiled program as
+  an implicit transfer, and the block fails as SanitizerViolation;
+- after warmup, steady-state queries stay within a zero-compile budget,
+  and a query that silently lands on a new bucket key inside the budget
+  block is caught.
+
+CPU-CI caveat (see sanitizer docstring): the guard enforces the implicit
+host->device direction only; device->host reads are zero-copy on CPU and
+pass.  That is exactly the direction the engine's staging discipline
+owns, so the check is meaningful on CPU and strictly stronger on real
+accelerators.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from openr_tpu.decision.csr import CsrTopology
+from openr_tpu.device import (
+    DeviceResidencyEngine,
+    EngineSanitizer,
+    SanitizerViolation,
+)
+from openr_tpu.utils.topo import grid_topology
+
+from test_link_state import build
+
+
+@pytest.fixture()
+def warm():
+    """Engine warmed on the 1- and 8-source buckets of a 16-node grid,
+    with one attribute flap pending so the sanitized query exercises the
+    incremental masked-write sync (the dispatch path with the most
+    host->device traffic)."""
+    dbs = grid_topology(4)
+    ls = build(dbs)
+    csr = CsrTopology.from_link_state(ls)
+    engine = DeviceResidencyEngine()
+    names = ls.node_names
+    engine.spf_results(csr, names[:1])  # compile bucket 1
+    engine.spf_results(csr, names[:3])  # compile bucket 8
+    # pending attribute flap: metric write syncs on device at next query
+    dbs[0].adjacencies[0].metric = 37
+    ls.update_adjacency_database(dbs[0])
+    assert csr.refresh(ls) is True
+    return engine, csr, ls, names
+
+
+def _oracle_check(engine, csr, ls, sources):
+    got = engine.spf_results(csr, sources)
+    for src in sources:
+        oracle = ls.run_spf(src)
+        assert {k: v.metric for k, v in oracle.items()} == {
+            k: v.metric for k, v in got[src].items()
+        }, src
+
+
+class TestTransferGuard:
+    def test_real_dispatch_paths_are_clean(self, warm):
+        """Incremental sync + warm queries under the guard, bit-exact."""
+        engine, csr, ls, names = warm
+        san = EngineSanitizer(engine)
+        with san.sanitized():
+            _oracle_check(engine, csr, ls, names[:1])  # syncs the flap
+            _oracle_check(engine, csr, ls, names[:3])
+        c = engine.get_counters()
+        assert c["device.engine.incremental_updates"] == 1
+
+    def test_seeded_h2d_violation_is_caught(self, warm, monkeypatch):
+        """Drop the explicit device_put staging (the seeded bug): the
+        same dispatch now leaks host arrays into compiled programs and
+        the guard must fail the block."""
+        import openr_tpu.device.engine as engine_mod
+
+        engine, csr, ls, names = warm
+        san = EngineSanitizer(engine)
+        monkeypatch.setattr(
+            engine_mod.jax, "device_put", lambda x, *a, **kw: x
+        )
+        with pytest.raises(SanitizerViolation, match="implicit"):
+            with san.transfer_guard():
+                engine.spf_results(csr, names[:1])
+
+    def test_unrelated_errors_pass_through(self, warm):
+        """Only guard trips translate; other failures keep their type."""
+        engine, *_ = warm
+        san = EngineSanitizer(engine)
+        with pytest.raises(ValueError, match="unrelated"):
+            with san.transfer_guard():
+                raise ValueError("unrelated")
+
+
+class TestCompileBudget:
+    def test_steady_state_is_hit_only(self, warm):
+        engine, csr, ls, names = warm
+        san = EngineSanitizer(engine)
+        with san.compile_budget(0):
+            engine.spf_results(csr, names[:1])
+            engine.spf_results(csr, names[:2])  # same 8-bucket, still a hit
+
+    def test_seeded_new_bucket_compile_is_caught(self, warm):
+        """A steady-state block that silently crosses into an uncompiled
+        bucket (here: 9 sources -> the 64 bucket) must fail the budget."""
+        engine, csr, ls, names = warm
+        san = EngineSanitizer(engine)
+        with pytest.raises(SanitizerViolation, match="compiled 1 program"):
+            with san.compile_budget(0):
+                engine.spf_results(csr, names[:9])
+
+    def test_budget_allows_declared_compiles(self, warm):
+        engine, csr, ls, names = warm
+        san = EngineSanitizer(engine)
+        with san.compile_budget(1):
+            engine.spf_results(csr, names[:9])
+
+
+class TestWiredIntoDispatch:
+    def test_sanitized_composes_guard_and_budget(self, warm):
+        engine, csr, ls, names = warm
+        san = EngineSanitizer(engine)
+        with pytest.raises(SanitizerViolation):
+            with san.sanitized(allowed_compiles=0):
+                engine.spf_results(csr, names[:9])
+        # np.asarray-style reads of device results stay allowed (CPU D2H
+        # is zero-copy; the guard owns the H2D direction)
+        res = engine.spf_results(csr, names[:1])
+        with san.transfer_guard():
+            arr = np.asarray(
+                [r.metric for r in res[names[0]].values()], dtype=np.int64
+            )
+        assert arr.size > 0
