@@ -149,10 +149,17 @@ def main(argv: list[str] | None = None) -> int:
 
     findings = reporter.sorted_findings()
     if changed is not None:
+        # counter hygiene is cross-referenced whole-tree, so a serving.*
+        # counter finding can anchor to an UNCHANGED file (e.g. a key
+        # seeded in serving/ but orphaned by an edit elsewhere) — the
+        # serving layer's SLO counters must never be filtered out of a
+        # pre-commit pass
         findings = [
             f
             for f in findings
-            if f.rule.startswith("program-") or f.path in changed
+            if f.rule.startswith("program-")
+            or f.path in changed
+            or (f.rule.startswith("counter-") and "serving." in f.message)
         ]
 
     if args.fmt == "json":

@@ -40,6 +40,7 @@ DEFAULT_MODULE_ATTRS = [
     "thrift_shim",
     "netlink",
     "watchdog",
+    "serving",
 ]
 
 

@@ -14,6 +14,7 @@ from .chaos import (
     KvChaosInjector,
     LinkFaultProfile,
 )
+from .overload import LoadReport, OpenLoopLoadGen
 from .scenario import ChaosScenario, fib_unicast_routes, oracle_route_dbs
 
 __all__ = [
@@ -24,6 +25,8 @@ __all__ = [
     "FibChaosPlan",
     "KvChaosInjector",
     "LinkFaultProfile",
+    "LoadReport",
+    "OpenLoopLoadGen",
     "fib_unicast_routes",
     "oracle_route_dbs",
 ]

@@ -11,6 +11,7 @@ the chaos under test.
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Optional
 
 from ..decision.spf_solver import HostSpfBackend, SpfSolver
@@ -83,15 +84,50 @@ class ChaosScenario:
         self.log.append(SCENARIO_STREAM, f"{name}:{'ok' if ok else 'timeout'}")
         return ok
 
-    def wait_converged(self, daemons, timeout_s: float = 30.0) -> bool:
+    def wait_converged(
+        self,
+        daemons,
+        timeout_s: float = 30.0,
+        hold_s: float = 0.5,
+    ) -> bool:
         """Wait until every daemon's FIB bit-exactly matches its own
-        host-oracle recompute (stable across two consecutive polls, so a
-        rebuild in flight between the FIB read and the oracle read does
-        not produce a false positive)."""
+        host-oracle recompute AND the match holds for a full ``hold_s``
+        quiescence window with no new route publications.
 
-        def _all_match() -> bool:
-            return all(fib_matches_oracle(d) for d in daemons) and all(
-                fib_matches_oracle(d) for d in daemons
+        Two instantaneous polls are not enough on a loaded box: a rebuild
+        can land between the FIB read and the oracle read, or (worse) the
+        match can be momentarily true while a late update is still queued,
+        so a snapshot taken right after the wait races the final write.
+        The hold window requires the match to stay true continuously and
+        pins the daemons' route-publication write counters across it — if
+        anything publishes mid-window the hold restarts from the new state.
+        The log entry stays ``converged:ok``/``converged:timeout`` so
+        same-seed replay logs still compare equal.
+        """
+
+        def _writes() -> tuple[int, ...]:
+            return tuple(
+                d.route_updates_queue.get_num_writes() for d in daemons
             )
 
-        return self.wait("converged", _all_match, timeout_s)
+        deadline = time.monotonic() + timeout_s
+        ok = False
+        while time.monotonic() < deadline and not ok:
+            if not all(fib_matches_oracle(d) for d in daemons):
+                time.sleep(0.05)
+                continue
+            w0 = _writes()
+            hold_end = time.monotonic() + hold_s
+            held = True
+            while time.monotonic() < hold_end:
+                time.sleep(0.05)
+                if _writes() != w0 or not all(
+                    fib_matches_oracle(d) for d in daemons
+                ):
+                    held = False
+                    break
+            ok = held and _writes() == w0
+        self.log.append(
+            SCENARIO_STREAM, f"converged:{'ok' if ok else 'timeout'}"
+        )
+        return ok

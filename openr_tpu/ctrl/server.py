@@ -17,7 +17,7 @@ import fnmatch
 import json
 import logging
 import re
-from typing import Any, Callable, Optional
+from typing import Any, Awaitable, Callable, Optional
 
 from ..runtime.eventbase import OpenrEventBase
 from ..runtime.queue import QueueClosedError, ReplicateQueue
@@ -51,6 +51,7 @@ class OpenrCtrlHandler:
         monitor=None,
         netlink=None,
         device=None,
+        serving=None,
         config=None,
         kvstore_updates_queue: Optional[ReplicateQueue[Publication]] = None,
         fib_updates_queue: Optional[ReplicateQueue] = None,
@@ -73,10 +74,18 @@ class OpenrCtrlHandler:
         # device-residency engine (openr_tpu.device.DeviceResidencyEngine):
         # exports device.engine.* through get_counters like any module
         self.device = device
+        # query scheduler (openr_tpu.serving.QueryScheduler): async query
+        # methods below submit into its admission queue; exports serving.*
+        self.serving = serving
         self.config = config
         self.kvstore_updates_queue = kvstore_updates_queue
         self.fib_updates_queue = fib_updates_queue
         self.methods: dict[str, Callable[[dict], Any]] = {}
+        # coroutine-valued methods awaited on the server loop instead of
+        # the executor: serving queries park on the scheduler's future,
+        # so an executor thread per in-flight query would defeat the
+        # admission queue's purpose
+        self.async_methods: dict[str, Callable[[dict], Awaitable[Any]]] = {}
         self._register_methods()
 
     def _need(self, module, name: str):
@@ -195,6 +204,12 @@ class OpenrCtrlHandler:
             self.decision, "decision"
         ).clear_rib_policy()
 
+        # -- serving (async: admission-queued, coalesced, batched) ------------
+        a = self.async_methods
+        a["queryPaths"] = lambda p: self._serving_query("paths", p)
+        a["queryWhatIf"] = lambda p: self._serving_query("what_if", p)
+        a["queryKsp"] = lambda p: self._serving_query("ksp", p)
+
         # -- fib --------------------------------------------------------------
         m["getRouteDbFib"] = self._fib_route_db
         m["getUnicastRoutesFiltered"] = lambda p: self._need(
@@ -276,6 +291,57 @@ class OpenrCtrlHandler:
         m["getAdvertisedRoutesFiltered"] = self._advertised_routes
         m["getRouteDetailDb"] = self._route_detail_db
 
+    # -- serving queries ------------------------------------------------------
+
+    async def _serving_query(self, op: str, p: dict) -> dict:
+        """Submit one query into the scheduler's admission queue and park
+        on its future (no executor thread held while queued/coalesced).
+        Sheds surface as explicit QueryShedError wire errors."""
+        serving = self._need(self.serving, "serving")
+        fut = serving.submit(
+            op,
+            area=p.get("area", "0"),
+            sources=p.get("sources") or (),
+            scenarios=[
+                [tuple(link) for link in sc]
+                for sc in (p.get("scenarios") or [])
+            ],
+            dests=p.get("dests") or (),
+            k=p.get("k", 2),
+            use_link_metric=p.get("useLinkMetric", True),
+        )
+        res = await asyncio.wrap_future(fut)
+        return {
+            "result": self._shape_query_value(op, res.value),
+            "epoch": res.epoch,
+            "batchSize": res.batch_size,
+            "latencyUs": res.latency_us,
+        }
+
+    @staticmethod
+    def _shape_query_value(op: str, value) -> Any:
+        if op == "paths":
+            # {source: SpfResult} -> JSON-able metric + next-hop sets
+            return {
+                src: {
+                    dest: {
+                        "metric": int(r.metric),
+                        "nextHops": sorted(r.next_hops),
+                    }
+                    for dest, r in spf.items()
+                }
+                for src, spf in value.items()
+            }
+        if op == "ksp":
+            # {dest: [Path]} -> hop-pair lists
+            return {
+                dest: [
+                    [[link.n1, link.n2] for link in path] for path in paths
+                ]
+                for dest, paths in value.items()
+            }
+        return value  # what_if rows are already wire-safe dicts
+
     # -- non-lambda handlers --------------------------------------------------
 
     def _all_counters(self) -> dict[str, int]:
@@ -290,6 +356,7 @@ class OpenrCtrlHandler:
             self.monitor,
             self.netlink,
             self.device,
+            self.serving,
         ):
             if module is None:
                 continue
@@ -590,6 +657,16 @@ class CtrlServer(OpenrEventBase):
             writer.close()
 
     async def _dispatch(self, msg_id, method, params, send) -> None:
+        afn = self.handler.async_methods.get(method)
+        if afn is not None:
+            # serving queries park on the scheduler future for the whole
+            # admission->coalesce->dispatch pipeline: run them as tracked
+            # tasks so one connection can pipeline many in-flight queries
+            task = asyncio.ensure_future(
+                self._run_async_method(msg_id, afn, params, send)
+            )
+            self._track(task)
+            return
         fn = self.handler.methods.get(method)
         if fn is None:
             await send({"id": msg_id, "error": f"unknown method {method!r}"})
@@ -604,6 +681,19 @@ class CtrlServer(OpenrEventBase):
         except Exception as e:  # noqa: BLE001
             log.debug("ctrl: %s failed", method, exc_info=True)
             await send({"id": msg_id, "error": f"{type(e).__name__}: {e}"})
+
+    async def _run_async_method(self, msg_id, afn, params, send) -> None:
+        try:
+            result = await afn(params)
+            await send({"id": msg_id, "result": to_wire(result)})
+        except (asyncio.CancelledError, ConnectionResetError):
+            pass
+        except Exception as e:  # noqa: BLE001
+            log.debug("ctrl: async method failed", exc_info=True)
+            try:
+                await send({"id": msg_id, "error": f"{type(e).__name__}: {e}"})
+            except (ConnectionResetError, RuntimeError):
+                pass
 
     # -- streaming (reference: OpenrCtrlHandler.h:240-273) --------------------
 

@@ -57,7 +57,22 @@ ENGINE_COUNTER_KEYS = (
     "device.engine.stage_us",
     "device.engine.compile_us",
     "device.engine.dispatch_us",
+    "device.engine.epoch_invalidations",
 )
+
+
+class EpochMismatchError(RuntimeError):
+    """The caller pinned a topology epoch (`expect_epoch`) that no longer
+    matches the CsrTopology — a flap landed between coalescing and
+    dispatch.  The serving layer catches this and recomputes against the
+    fresh topology instead of serving stale routes."""
+
+    def __init__(self, expected: int, actual: int) -> None:
+        super().__init__(
+            f"topology epoch moved: expected {expected}, now {actual}"
+        )
+        self.expected = expected
+        self.actual = actual
 
 
 def _s_bucket(s: int) -> int:
@@ -396,12 +411,26 @@ class DeviceResidencyEngine:
 
     # -- queries ------------------------------------------------------------
 
-    def spf_results(self, csr, sources: list, use_link_metric: bool = True):
+    def spf_results(
+        self,
+        csr,
+        sources: list,
+        use_link_metric: bool = True,
+        expect_epoch: Optional[int] = None,
+    ):
         """Full production pipeline through residency: distances + SP-DAG
         + bit-packed first hops -> reference-shaped SpfResults.  Same
-        contract as CsrTopology.spf_from, minus the per-call staging."""
+        contract as CsrTopology.spf_from, minus the per-call staging.
+
+        `expect_epoch` pins the csr.version the caller coalesced against:
+        if the topology moved since, the query raises EpochMismatchError
+        *before* any device work, so batched callers never receive routes
+        computed over a topology older than the one they observed."""
         if self.fault_hook is not None:
             self.fault_hook("spf")
+        if expect_epoch is not None and int(csr.version) != int(expect_epoch):
+            self._bump("device.engine.epoch_invalidations")
+            raise EpochMismatchError(int(expect_epoch), int(csr.version))
         if not sources:
             return {}
         t_query = time.perf_counter()

@@ -171,6 +171,26 @@ _LONG_POLL_AREA_ARGS = tb.StructSpec(
         ),
     ),
 )
+# serving: queryPathsBatched(1: list<string> sources, 2: string area)
+# -> map<source, map<dest, i64 distance>> (new capability, no reference
+# RPC; rides the QueryScheduler's admission/coalescing pipeline)
+_QUERY_PATHS_ARGS = tb.StructSpec(
+    "queryPathsBatched_args",
+    None,
+    (
+        tb.Field(
+            1,
+            "sources",
+            ("list", tb.T_STRING),
+            dec=lambda xs: [x.decode() for x in xs],
+            default=[],
+        ),
+        tb.Field(
+            2, "area", tb.T_STRING, dec=lambda b: b.decode(), default="0"
+        ),
+    ),
+)
+_DISTANCES_MAP = ("map", tb.T_STRING, ("map", tb.T_STRING, tb.T_I64))
 
 
 class ThriftBinaryShim(OpenrEventBase):
@@ -184,9 +204,11 @@ class ThriftBinaryShim(OpenrEventBase):
         node_name: str = "",
         decision=None,
         fib=None,
+        serving=None,
         counters_fn=None,
         kvstore_updates_queue=None,
         long_poll_timeout_s: float = 20.0,
+        query_timeout_s: float = 60.0,
     ) -> None:
         super().__init__(name="thrift-shim")
         self.kvstore = kvstore
@@ -195,6 +217,10 @@ class ThriftBinaryShim(OpenrEventBase):
         self.node_name = node_name
         self.decision = decision
         self.fib = fib
+        # QueryScheduler (openr_tpu.serving): queryPathsBatched submits
+        # into its admission queue; sheds answer as thrift exceptions
+        self.serving = serving
+        self.query_timeout_s = query_timeout_s
         # () -> dict[str, int]: the daemon passes the ctrl server's
         # merged per-module counter dump (fb303 getCounters semantics)
         self.counters_fn = counters_fn
@@ -546,6 +572,27 @@ class ThriftBinaryShim(OpenrEventBase):
                 return self._reply(
                     name, seqid, ("list", ("struct", tb.MPLS_ROUTE)), mpls
                 )
+            if name == "queryPathsBatched":
+                # one submit per source: the scheduler's coalescer groups
+                # them into one engine dispatch (same epoch, same op), so
+                # an N-source call costs one device batch, not N
+                args = tb.read_struct(r, _QUERY_PATHS_ARGS)
+                if self.serving is None:
+                    raise RuntimeError("serving module not attached")
+                futs = [
+                    (src, self.serving.submit(
+                        "paths", area=args["area"], sources=(src,)
+                    ))
+                    for src in args["sources"]
+                ]
+                wire: dict[str, dict[str, int]] = {}
+                for src, fut in futs:
+                    res = fut.result(timeout=self.query_timeout_s)
+                    spf = res.value.get(src, {})
+                    wire[src] = {
+                        dest: int(nr.metric) for dest, nr in spf.items()
+                    }
+                return self._reply(name, seqid, _DISTANCES_MAP, wire)
             if name == "setKvStoreKeyVals":
                 args = tb.read_struct(r, _SET_ARGS)
                 params = args["set_params"]

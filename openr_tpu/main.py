@@ -220,6 +220,7 @@ class OpenrDaemon:
         self.kvstore_client: Optional[KvStoreClientInternal] = None
         self.prefix_manager: Optional[PrefixManager] = None
         self.prefix_allocator: Optional[PrefixAllocator] = None
+        self.serving = None  # serving.QueryScheduler (started in start())
         self.ctrl_server: Optional[CtrlServer] = None
         self.thrift_shim = None  # interop.shim.ThriftBinaryShim when enabled
         self._plugin = None
@@ -311,6 +312,20 @@ class OpenrDaemon:
             if self.watchdog is not None:
                 self.watchdog.add_evb(module)
 
+        # serving layer BEFORE the wire surfaces that submit into it:
+        # queries marshal onto the Decision thread in coalesced batches
+        # (serving.DecisionBatchBackend), so Decision must already be up
+        from .serving import DecisionBatchBackend, QueryScheduler
+
+        self.serving = QueryScheduler(DecisionBatchBackend(self.decision))
+        self.serving.run()
+        if self.watchdog is not None:
+            self.watchdog.add_evb(self.serving)
+        # admission-queue stats ride the queue.* counter surface next to
+        # the inter-module fabric (queue.serving_admission.overflows is
+        # the first overload signal; see docs/OPERATIONS.md)
+        self._queues["serving_admission"] = self.serving.admission
+
         handler = OpenrCtrlHandler(
             self.config.node_name,
             kvstore=self.kvstore,
@@ -325,6 +340,7 @@ class OpenrDaemon:
             # device-residency engine counters (device.engine.*) ride the
             # same getCounters surface as every module's
             device=getattr(self.decision.spf_solver.spf, "engine", None),
+            serving=self.serving,
             kvstore_updates_queue=self.kvstore_updates_queue,
             fib_updates_queue=self.fib_updates_queue,
             config_store=self.config_store,
@@ -354,6 +370,7 @@ class OpenrDaemon:
                 node_name=self.config.node_name,
                 decision=self.decision,
                 fib=self.fib,
+                serving=self.serving,
                 counters_fn=self.ctrl_server.handler._all_counters,
                 kvstore_updates_queue=self.kvstore_updates_queue,
             )
@@ -395,6 +412,9 @@ class OpenrDaemon:
         modules = [
             self.thrift_shim,
             self.ctrl_server,
+            # serving after its wire surfaces (no new submissions), before
+            # the Decision thread its batches marshal onto
+            self.serving,
             self.fib,
             self.decision,
             self.prefix_manager,

@@ -20,7 +20,7 @@ from __future__ import annotations
 import asyncio
 import threading
 from collections import deque
-from typing import Any, Generic, Iterable, Optional, TypeVar
+from typing import Any, Callable, Generic, Iterable, Optional, TypeVar
 
 T = TypeVar("T")
 
@@ -58,9 +58,17 @@ class RQueue(Generic[T]):
 
 
 class RWQueue(Generic[T]):
-    def __init__(self, maxlen: Optional[int] = None) -> None:
+    def __init__(
+        self,
+        maxlen: Optional[int] = None,
+        on_shed: Optional[Callable[[T], None]] = None,
+    ) -> None:
         self._items: deque[T] = deque()
         self._maxlen = maxlen
+        # called with each item dropped by the bounded-queue overflow
+        # policy, OUTSIDE the queue lock — lets owners turn a silent
+        # drop-oldest into an explicit per-item error (serving layer)
+        self._on_shed = on_shed
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._closed = False
@@ -72,6 +80,7 @@ class RWQueue(Generic[T]):
     # -- write side ---------------------------------------------------------
 
     def push(self, item: T) -> bool:
+        shed: Optional[T] = None
         with self._lock:
             if self._closed:
                 return False
@@ -79,13 +88,17 @@ class RWQueue(Generic[T]):
                 # bounded queue: shed the OLDEST item (routing deltas are
                 # superseded by later state; blocking the producer would
                 # wedge the pushing module's event base instead)
-                self._items.popleft()
+                shed = self._items.popleft()
                 self._num_overflows += 1
             self._items.append(item)
             self._num_pushed += 1
             self._cond.notify()
             waiters, self._async_waiters = self._async_waiters, []
         self._wake(waiters)
+        if shed is not None and self._on_shed is not None:
+            # outside the lock: shed handlers complete caller futures,
+            # whose done-callbacks must never run under the queue lock
+            self._on_shed(shed)
         return True
 
     def close(self) -> None:

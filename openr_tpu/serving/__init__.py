@@ -1,0 +1,31 @@
+"""Query-serving layer: async admission, batch coalescing, and
+double-buffered dispatch in front of the device-residency engine.
+
+The residency engine (openr_tpu.device) is a solver — one caller at a
+time through the Decision event loop.  This package turns it into a
+service: concurrent clients submit path/what-if/KSP queries into a
+bounded admission queue, a coalescer groups compatible queries (same
+topology epoch, same op) into one engine dispatch that rides the
+existing shape-bucketed program ladder, and a double-buffered dispatch
+loop stages batch i+1 while batch i runs.  See
+docs/ARCHITECTURE.md "Query-serving layer".
+"""
+
+from .backend import DecisionBatchBackend, EngineBatchBackend
+from .scheduler import (
+    SERVING_COUNTER_KEYS,
+    Query,
+    QueryResult,
+    QueryScheduler,
+    QueryShedError,
+)
+
+__all__ = [
+    "DecisionBatchBackend",
+    "EngineBatchBackend",
+    "Query",
+    "QueryResult",
+    "QueryScheduler",
+    "QueryShedError",
+    "SERVING_COUNTER_KEYS",
+]

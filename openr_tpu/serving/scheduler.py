@@ -1,0 +1,452 @@
+"""QueryScheduler: admission -> coalesce -> double-buffered dispatch.
+
+Pipeline (one OpenrEventBase thread + one single-worker executor):
+
+1. **Admission** — client threads call `submit()`, which enqueues a
+   `_Pending` into a bounded `RWQueue`.  The queue keeps its drop-oldest
+   overflow policy, but the serving layer attaches an `on_shed` handler
+   so every shed query completes its caller's future with an explicit
+   `QueryShedError` — overload sheds loudly, never silently.
+2. **Coalescing** — a fiber drains the admission queue and groups
+   compatible queries (same op, same area, same topology epoch, same
+   mode) into one `_Batch`.  A batch of 5 path queries rides the
+   engine's S=8 bucketed program: one dispatch, five replies.
+3. **Double-buffered dispatch** — batches move through a 1-slot staging
+   queue into a single-worker executor.  While batch i computes on the
+   device, the coalescer is already staging batch i+1; when the executor
+   frees, the staged batch dispatches immediately.
+4. **Invalidation** — each batch pins the topology epoch it coalesced
+   against.  The engine (device/engine.py `expect_epoch`) refuses to
+   serve a moved topology, so a flap that lands between coalescing and
+   dispatch triggers a recompute against the fresh epoch instead of
+   serving stale routes.
+
+Accounting lives under `serving.*` and is exported through
+`OpenrCtrlHandler._all_counters` / the fb303 shim like every module.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from ..runtime.eventbase import OpenrEventBase
+from ..runtime.queue import QueueClosedError, RWQueue
+
+log = logging.getLogger(__name__)
+
+SERVING_COUNTER_KEYS = (
+    "serving.admitted",
+    "serving.coalesced",
+    "serving.shed",
+    "serving.batches",
+    "serving.invalidations",
+    "serving.host_fallbacks",
+    "serving.replies",
+    "serving.errors",
+    "serving.batch_occupancy",
+    "serving.p50_us",
+    "serving.p99_us",
+)
+
+# bounded retry against a topology that moves between coalescing and
+# dispatch; each retry re-reads the epoch and recomputes fresh
+_MAX_EPOCH_RETRIES = 3
+
+_OPS = ("paths", "what_if", "ksp")
+
+
+class QueryShedError(RuntimeError):
+    """The query was shed by admission control (queue overflow, closed
+    admission, or scheduler shutdown).  Every shed query gets this as an
+    explicit error reply — never a silent drop."""
+
+
+@dataclass(frozen=True)
+class Query:
+    """One client question.  `sources`/`dests`/`scenarios` are tuples so
+    queries are hashable and batch keys stay value-typed."""
+
+    op: str  # "paths" | "what_if" | "ksp"
+    area: str = "0"
+    sources: tuple = ()
+    scenarios: tuple = ()  # what_if: tuple of scenario link tuples
+    dests: tuple = ()  # ksp
+    k: int = 2  # ksp
+    use_link_metric: bool = True  # paths
+
+
+@dataclass
+class QueryResult:
+    """Per-query reply with latency attribution."""
+
+    value: Any
+    latency_us: int
+    batch_size: int
+    epoch: int
+
+
+@dataclass(eq=False)  # identity semantics: lives in the _inflight set
+class _Pending:
+    query: Query
+    future: "concurrent.futures.Future[QueryResult]"
+    t_submit: float
+
+
+@dataclass
+class _Batch:
+    key: tuple
+    op: str
+    area: str
+    epoch: int
+    pendings: list = field(default_factory=list)
+
+
+def _pctl_us(sorted_us: list, p: int) -> int:
+    if not sorted_us:
+        return 0
+    i = min(len(sorted_us) - 1, (len(sorted_us) * p) // 100)
+    return int(sorted_us[i])
+
+
+class QueryScheduler(OpenrEventBase):
+    """Serving front-end between the ctrl/thrift surfaces and a batch
+    backend (serving.backend): admission queue, epoch-keyed coalescer,
+    double-buffered dispatch loop."""
+
+    def __init__(
+        self,
+        backend,
+        max_pending: int = 1024,
+        max_coalesce: int = 64,
+    ) -> None:
+        super().__init__(name="serving")
+        self.backend = backend
+        # route the backend's counter bumps (serving.host_fallbacks) into
+        # this scheduler's serving.* registry
+        if hasattr(backend, "_bump"):
+            backend._bump = self._bump
+        self.max_coalesce = max_coalesce
+        # bounded admission: overflow sheds the OLDEST pending query and
+        # the on_shed hook turns that into an explicit error reply
+        self.admission: RWQueue[_Pending] = RWQueue(
+            maxlen=max_pending, on_shed=self._on_admission_shed
+        )
+        self._accepting = True
+        # 1-slot staging queue + 1-worker executor = the double buffer:
+        # the coalescer fills the slot while the worker runs batch i
+        self._staged: Optional[asyncio.Queue] = None
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="serving-exec"
+        )
+        self._lock = threading.Lock()
+        self.counters: dict[str, int] = {k: 0 for k in SERVING_COUNTER_KEYS}
+        self._latencies_us: deque = deque(maxlen=2048)
+        self._occupancy_sum = 0
+        self._occupancy_batches = 0
+        # every admitted-but-unanswered query; anything left here at
+        # shutdown is failed explicitly (zero silent drops)
+        self._inflight: set = set()
+        # test/chaos seam: called with (event, batch) at stage and
+        # execute boundaries — the double-buffer overlap test hangs here
+        self.trace_hook: Optional[Callable[[str, Any], None]] = None
+
+    # -- counters ------------------------------------------------------------
+
+    def _bump(self, name: str, delta: int = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + delta
+
+    def get_counters(self) -> dict[str, int]:
+        with self._lock:
+            counters = dict(self.counters)
+            lats = sorted(self._latencies_us)
+            occ_sum = self._occupancy_sum
+            occ_n = self._occupancy_batches
+        # derived gauges: mean batch occupancy in milli-queries-per-batch
+        # (integer wire format), latency percentiles over a sliding ring
+        counters["serving.batch_occupancy"] = (
+            (occ_sum * 1000) // occ_n if occ_n else 0
+        )
+        counters["serving.p50_us"] = _pctl_us(lats, 50)
+        counters["serving.p99_us"] = _pctl_us(lats, 99)
+        return counters
+
+    # -- admission (any thread) ----------------------------------------------
+
+    def submit(
+        self,
+        op: str,
+        *,
+        area: str = "0",
+        sources=(),
+        scenarios=(),
+        dests=(),
+        k: int = 2,
+        use_link_metric: bool = True,
+    ) -> "concurrent.futures.Future[QueryResult]":
+        """Enqueue one query; returns a future resolving to QueryResult
+        or raising QueryShedError / the compute error.  Never blocks the
+        caller: over capacity, admission sheds (explicitly)."""
+        if op not in _OPS:
+            raise ValueError(f"unknown op {op!r} (expected one of {_OPS})")
+        query = Query(
+            op=op,
+            area=area,
+            sources=tuple(sources),
+            scenarios=tuple(tuple(tuple(l) for l in sc) for sc in scenarios),
+            dests=tuple(dests),
+            k=int(k),
+            use_link_metric=bool(use_link_metric),
+        )
+        fut: "concurrent.futures.Future[QueryResult]" = (
+            concurrent.futures.Future()
+        )
+        pending = _Pending(query, fut, time.perf_counter())
+        if not self._accepting or not self.admission.push(pending):
+            self._bump("serving.shed")
+            fut.set_exception(QueryShedError("admission closed"))
+            return fut
+        with self._lock:
+            self._inflight.add(pending)
+        self._bump("serving.admitted")
+        return fut
+
+    def _on_admission_shed(self, pending: _Pending) -> None:
+        # runs on the pushing thread, OUTSIDE the queue lock
+        self._fail(pending, QueryShedError("admission queue overflow"))
+
+    def _fail(self, pending: _Pending, exc: Exception) -> None:
+        with self._lock:
+            self._inflight.discard(pending)
+        if pending.future.done():
+            return
+        if isinstance(exc, QueryShedError):
+            self._bump("serving.shed")
+        else:
+            self._bump("serving.errors")
+        pending.future.set_exception(exc)
+
+    # -- coalescing (event-base fiber) ---------------------------------------
+
+    @staticmethod
+    def _batch_key(query: Query, epoch: int) -> tuple:
+        if query.op == "paths":
+            return ("paths", query.area, epoch, query.use_link_metric)
+        if query.op == "what_if":
+            # what-if impact counting is relative to the source set, so
+            # only identical views coalesce (scenarios concatenate)
+            return ("what_if", query.area, epoch, query.sources)
+        return ("ksp", query.area, epoch, query.sources, query.k)
+
+    async def prepare(self) -> None:
+        self._staged = asyncio.Queue(maxsize=1)
+        loop = asyncio.get_running_loop()
+        self._track(
+            loop.create_task(self._coalesce_loop(), name="serving-coalesce")
+        )
+        self._track(
+            loop.create_task(self._dispatch_loop(), name="serving-dispatch")
+        )
+
+    async def _coalesce_loop(self) -> None:
+        try:
+            while True:
+                first = await self.admission.aget()
+                drained = [first]
+                while len(drained) < self.max_coalesce:
+                    try:
+                        nxt = self.admission.try_get()
+                    except QueueClosedError:
+                        break
+                    if nxt is None:
+                        break
+                    drained.append(nxt)
+                # one epoch read per area per round: every query grouped
+                # here pins the SAME topology version
+                epochs: dict[str, int] = {}
+                batches: dict[tuple, _Batch] = {}
+                for pending in drained:
+                    q = pending.query
+                    epoch = epochs.get(q.area)
+                    if epoch is None:
+                        epoch = int(self.backend.epoch(q.area))
+                        epochs[q.area] = epoch
+                    key = self._batch_key(q, epoch)
+                    batch = batches.get(key)
+                    if batch is None:
+                        batch = _Batch(key, q.op, q.area, epoch)
+                        batches[key] = batch
+                    batch.pendings.append(pending)
+                for batch in batches.values():
+                    if self.trace_hook is not None:
+                        self.trace_hook("stage", batch)
+                    await self._staged.put(batch)
+        except (QueueClosedError, asyncio.CancelledError):
+            pass
+
+    # -- dispatch (double buffer) --------------------------------------------
+
+    async def _dispatch_loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        try:
+            while True:
+                batch = await self._staged.get()
+                # hand the batch to the single worker; the staging slot is
+                # now free, so the coalescer overlaps batch i+1 with this
+                # execution
+                await loop.run_in_executor(self._pool, self._execute, batch)
+        except asyncio.CancelledError:
+            pass
+
+    def _execute(self, batch: _Batch) -> None:
+        from ..device.engine import EpochMismatchError
+
+        if self.trace_hook is not None:
+            self.trace_hook("execute_begin", batch)
+        try:
+            per_query: Optional[list] = None
+            error: Optional[Exception] = None
+            for _attempt in range(_MAX_EPOCH_RETRIES):
+                try:
+                    per_query = self._run_batch(batch)
+                    error = None
+                    break
+                except EpochMismatchError as e:
+                    # a flap landed between coalescing and dispatch:
+                    # re-pin the fresh epoch and recompute — coalesced
+                    # work is invalidated, never served stale
+                    self._bump("serving.invalidations")
+                    batch.epoch = int(self.backend.epoch(batch.area))
+                    error = e
+                except Exception as e:  # noqa: BLE001
+                    log.debug(
+                        "serving: batch %s failed", batch.op, exc_info=True
+                    )
+                    error = e
+                    break
+            n = len(batch.pendings)
+            with self._lock:
+                self.counters["serving.batches"] += 1
+                self._occupancy_sum += n
+                self._occupancy_batches += 1
+            if n > 1:
+                self._bump("serving.coalesced", n - 1)
+            if error is not None or per_query is None:
+                exc = error or RuntimeError("serving: batch produced nothing")
+                for pending in batch.pendings:
+                    self._fail(pending, exc)
+                return
+            t_done = time.perf_counter()
+            for pending, value in zip(batch.pendings, per_query):
+                latency_us = int((t_done - pending.t_submit) * 1e6)
+                with self._lock:
+                    self._inflight.discard(pending)
+                    self._latencies_us.append(latency_us)
+                if pending.future.done():
+                    continue
+                self._bump("serving.replies")
+                pending.future.set_result(
+                    QueryResult(
+                        value=value,
+                        latency_us=latency_us,
+                        batch_size=n,
+                        epoch=batch.epoch,
+                    )
+                )
+        finally:
+            if self.trace_hook is not None:
+                self.trace_hook("execute_end", batch)
+
+    def _run_batch(self, batch: _Batch) -> list:
+        """One backend call for the whole batch; returns per-query values
+        aligned with batch.pendings."""
+        queries = [p.query for p in batch.pendings]
+        if batch.op == "paths":
+            # stable-order union of every query's sources
+            merged = list(
+                dict.fromkeys(s for q in queries for s in q.sources)
+            )
+            results = self.backend.run_paths(
+                batch.area,
+                merged,
+                use_link_metric=queries[0].use_link_metric,
+                expect_epoch=batch.epoch,
+            )
+            return [
+                {s: results[s] for s in q.sources if s in results}
+                for q in queries
+            ]
+        if batch.op == "what_if":
+            merged_sc: list = []
+            offsets: list[tuple[int, int]] = []
+            for q in queries:
+                offsets.append(
+                    (len(merged_sc), len(merged_sc) + len(q.scenarios))
+                )
+                merged_sc.extend(list(map(list, sc)) for sc in q.scenarios)
+            rows = self.backend.run_what_if(
+                batch.area,
+                list(queries[0].sources),
+                merged_sc,
+                expect_epoch=batch.epoch,
+            )
+            out = []
+            for lo, hi in offsets:
+                mine = []
+                for i, row in enumerate(rows[lo:hi]):
+                    row = dict(row)
+                    row["scenario"] = i  # renumber to the query's view
+                    mine.append(row)
+                out.append(mine)
+            return out
+        # ksp: one source, union of destination sets
+        merged_d = list(dict.fromkeys(d for q in queries for d in q.dests))
+        source = queries[0].sources[0] if queries[0].sources else ""
+        results = self.backend.run_ksp(
+            batch.area,
+            source,
+            merged_d,
+            k=queries[0].k,
+            expect_epoch=batch.epoch,
+        )
+        return [{d: results.get(d, []) for d in q.dests} for q in queries]
+
+    # -- shutdown ------------------------------------------------------------
+
+    async def stopping(self) -> None:
+        self._accepting = False
+        self.admission.close()
+        # fail everything still waiting in admission
+        while True:
+            try:
+                pending = self.admission.try_get()
+            except QueueClosedError:
+                break
+            if pending is None:
+                break
+            self._fail(pending, QueryShedError("scheduler stopping"))
+        # and a staged-but-undispatched batch
+        if self._staged is not None:
+            while not self._staged.empty():
+                batch = self._staged.get_nowait()
+                for pending in batch.pendings:
+                    self._fail(pending, QueryShedError("scheduler stopping"))
+
+    def stop(self) -> None:
+        self._accepting = False
+        super().stop()
+        # let an in-flight batch finish answering its callers, then fail
+        # any stragglers: every admitted query resolves, one way or the
+        # other
+        self._pool.shutdown(wait=True)
+        with self._lock:
+            leftovers = [p for p in self._inflight if not p.future.done()]
+        for pending in leftovers:
+            self._fail(pending, QueryShedError("scheduler stopped"))

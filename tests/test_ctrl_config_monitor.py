@@ -418,6 +418,10 @@ class TestCounterRegistrySweep:
                 # the device-residency engine pre-seeds its registry, so
                 # the family is dumpable before any device query runs
                 "device.engine.queries",
+                # the query scheduler pre-seeds serving.* the same way,
+                # and its admission RWQueue rides the daemon queue fabric
+                "serving.admitted",
+                "queue.serving_admission.overflows",
             ):
                 assert key in counters, f"{key} missing from getCounters"
 
@@ -465,3 +469,49 @@ class TestCounterRegistrySweep:
             shim.stop()
             shim.wait_until_stopped(5)
         assert set(ENGINE_COUNTER_KEYS) <= set(shimmed)
+
+    def test_serving_family_on_both_wire_surfaces(self, daemon):
+        """The full serving.* registry (admission, coalescing, shedding,
+        latency gauges) answers ONE getCounters on the native ctrl
+        server AND the fb303 shim, convention-clean, with no per-key
+        plumbing — the scheduler rides _all_counters like any module."""
+        import re
+
+        from openr_tpu.interop import thrift_binary as tb
+        from openr_tpu.interop.shim import ThriftBinaryShim
+        from openr_tpu.serving import SERVING_COUNTER_KEYS
+        from test_thrift_binary import _call_ok
+
+        client = CtrlClient(port=daemon.ctrl_port)
+        try:
+            native = client.call("getCounters")
+        finally:
+            client.close()
+        assert set(SERVING_COUNTER_KEYS) <= set(native)
+        # the admission queue is registered in the daemon fabric, so its
+        # overflow ledger is on the same surface the runbook points at
+        assert "queue.serving_admission.overflows" in native
+
+        name_re = re.compile(r"[a-z][a-z0-9_]*(\.[a-z0-9_]+)+\Z")
+        assert all(name_re.match(k) for k in SERVING_COUNTER_KEYS)
+
+        shim = ThriftBinaryShim(
+            daemon.kvstore,
+            port=0,
+            node_name="solo",
+            counters_fn=daemon.ctrl_server.handler._all_counters,
+        )
+        shim.run()
+        try:
+            shimmed = _call_ok(
+                shim.port,
+                "getCounters",
+                42,
+                b"\x00",
+                ("map", tb.T_STRING, tb.T_I64),
+                dec=lambda m: {k.decode(): v for k, v in m.items()},
+            )
+        finally:
+            shim.stop()
+            shim.wait_until_stopped(5)
+        assert set(SERVING_COUNTER_KEYS) <= set(shimmed)

@@ -1,0 +1,522 @@
+"""Query-serving layer tests (openr_tpu/serving): admission control,
+epoch-keyed coalescing, double-buffered dispatch, invalidation-on-flap,
+explicit shedding, both wire surfaces, and the seeded overload scenario.
+
+Every batched answer is held to the serial baseline: the same query
+submitted alone through the same backend, and the host Dijkstra oracle
+(`LinkState.get_spf_result`).  Coalescing is made deterministic by
+parking the pipeline — one batch gated inside the executor, one in the
+staging slot, one in the coalescer's blocked put — so everything
+submitted afterwards must ride a single batch.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+
+import pytest
+
+from openr_tpu.chaos import OpenLoopLoadGen
+from openr_tpu.decision.spf_solver import DeviceSpfBackend
+from openr_tpu.device.engine import EpochMismatchError
+from openr_tpu.serving import (
+    EngineBatchBackend,
+    QueryScheduler,
+    QueryShedError,
+    SERVING_COUNTER_KEYS,
+)
+from openr_tpu.types import AdjacencyDatabase
+
+from test_spf_solver import adj, build_link_state, square
+from test_system import wait_for
+
+# force the device path on tiny topologies: the serving layer's whole
+# point is riding the engine's bucketed programs
+_DEVICE = dict(min_device_nodes=1, min_device_sources=1)
+
+
+def make_scheduler(ls=None, **kwargs):
+    ls = square() if ls is None else ls
+    backend = EngineBatchBackend(
+        {"0": ls}, spf_backend=DeviceSpfBackend(**_DEVICE)
+    )
+    sched = QueryScheduler(backend, **kwargs)
+    sched.run()
+    return ls, backend, sched
+
+
+def serial_backend(ls):
+    """A fresh backend for serial single-query baselines (its own engine,
+    so the scheduler's residency/cache state can't leak into it)."""
+    return EngineBatchBackend(
+        {"0": ls}, spf_backend=DeviceSpfBackend(**_DEVICE)
+    )
+
+
+class _Gate:
+    """trace_hook that records the pipeline event timeline and blocks
+    every execute until released."""
+
+    def __init__(self) -> None:
+        self.events: list[tuple[str, int, int]] = []  # (event, batch id, n)
+        self.release = threading.Event()
+        self._lock = threading.Lock()
+
+    def __call__(self, event: str, batch) -> None:
+        with self._lock:
+            self.events.append((event, id(batch), len(batch.pendings)))
+        if event == "execute_begin":
+            self.release.wait(15)
+
+    def count(self, event: str) -> int:
+        with self._lock:
+            return sum(1 for e in self.events if e[0] == event)
+
+
+def park_pipeline(sched, gate):
+    """Fill the double buffer: warm batch 1 gated inside the executor,
+    batch 2 in the staging slot, batch 3 in the coalescer's blocked put.
+    Everything submitted after this parks in the admission queue and is
+    coalesced in ONE round once the gate opens."""
+    warm = [sched.submit("paths", sources=("1",))]
+    assert wait_for(lambda: gate.count("execute_begin") == 1, 10)
+    warm.append(sched.submit("paths", sources=("1",)))
+    assert wait_for(lambda: gate.count("stage") == 2, 10)
+    warm.append(sched.submit("paths", sources=("1",)))
+    assert wait_for(lambda: gate.count("stage") == 3, 10)
+    return warm
+
+
+class TestCoalescingBitExact:
+    def test_paths_batch_bit_exact_vs_serial_and_oracle(self):
+        ls, backend, sched = make_scheduler()
+        gate = _Gate()
+        sched.trace_hook = gate
+        try:
+            warm = park_pipeline(sched, gate)
+            futs = {
+                s: sched.submit("paths", sources=(s,)) for s in "1234"
+            }
+            gate.release.set()
+            results = {s: f.result(20) for s, f in futs.items()}
+            for f in warm:
+                f.result(20)
+
+            # all four single-source queries rode ONE batch at one epoch
+            assert {r.batch_size for r in results.values()} == {4}
+            assert {r.epoch for r in results.values()} == {int(ls.version)}
+
+            serial = serial_backend(ls)
+            for s, r in results.items():
+                spf = r.value[s]
+                one = serial.run_paths(
+                    "0", [s], expect_epoch=int(ls.version)
+                )[s]
+                oracle = ls.get_spf_result(s)
+                for view in (one, oracle):
+                    assert set(spf) == set(view)
+                    for dest in view:
+                        assert spf[dest].metric == view[dest].metric
+                        assert spf[dest].next_hops == view[dest].next_hops
+
+            counters = sched.get_counters()
+            assert counters["serving.replies"] == 7
+            assert counters["serving.coalesced"] >= 3
+            assert counters["serving.shed"] == 0
+            assert counters["serving.errors"] == 0
+            # mean occupancy gauge is milli-queries-per-batch
+            assert counters["serving.batch_occupancy"] > 1000
+            assert counters["serving.p99_us"] >= counters["serving.p50_us"]
+        finally:
+            gate.release.set()
+            sched.stop()
+
+    def test_what_if_coalesced_matches_serial(self):
+        ls, backend, sched = make_scheduler()
+        gate = _Gate()
+        sched.trace_hook = gate
+        try:
+            warm = park_pipeline(sched, gate)
+            fa = sched.submit(
+                "what_if", sources=("1",), scenarios=((("1", "2"),),)
+            )
+            fb = sched.submit(
+                "what_if",
+                sources=("1",),
+                scenarios=((("3", "4"),), (("2", "4"),)),
+            )
+            gate.release.set()
+            ra, rb = fa.result(20), fb.result(20)
+            for f in warm:
+                f.result(20)
+            # same source view -> one coalesced what-if batch
+            assert ra.batch_size == 2 and rb.batch_size == 2
+
+            serial = serial_backend(ls)
+            sa = serial.run_what_if(
+                "0", ["1"], [[("1", "2")]], expect_epoch=int(ls.version)
+            )
+            sb = serial.run_what_if(
+                "0",
+                ["1"],
+                [[("3", "4")], [("2", "4")]],
+                expect_epoch=int(ls.version),
+            )
+            # scenario ids are renumbered to each query's own view
+            assert ra.value == sa
+            assert rb.value == sb
+            assert [row["scenario"] for row in rb.value] == [0, 1]
+        finally:
+            gate.release.set()
+            sched.stop()
+
+    def test_ksp_coalesced_matches_serial(self):
+        # 1-2-3 chain (10+10) plus a 50-metric direct 1-3 chord: k=2
+        # from "1" has a real second path
+        ls = build_link_state(
+            {
+                "1": [adj("1", "2"), adj("1", "3", metric=50)],
+                "2": [adj("2", "1"), adj("2", "3")],
+                "3": [adj("3", "2"), adj("3", "1", metric=50)],
+            }
+        )
+        ls, backend, sched = make_scheduler(ls)
+        gate = _Gate()
+        sched.trace_hook = gate
+        try:
+            warm = park_pipeline(sched, gate)
+            fa = sched.submit("ksp", sources=("1",), dests=("3",), k=2)
+            fb = sched.submit(
+                "ksp", sources=("1",), dests=("2", "3"), k=2
+            )
+            gate.release.set()
+            ra, rb = fa.result(20), fb.result(20)
+            for f in warm:
+                f.result(20)
+            assert ra.batch_size == 2 and rb.batch_size == 2
+
+            serial = serial_backend(ls)
+            sa = serial.run_ksp(
+                "0", "1", ["3"], k=2, expect_epoch=int(ls.version)
+            )
+            assert ra.value == sa
+            # the k=2 (edge-disjoint) tier is exactly the 1-3 chord
+            assert len(ra.value["3"]) == 1
+            assert len(ra.value["3"][0]) == 1
+            sb = serial.run_ksp(
+                "0", "1", ["2", "3"], k=2, expect_epoch=int(ls.version)
+            )
+            assert rb.value == sb
+        finally:
+            gate.release.set()
+            sched.stop()
+
+
+class TestPipelineMechanics:
+    def test_double_buffer_overlaps_stage_with_execute(self):
+        ls, backend, sched = make_scheduler()
+        gate = _Gate()
+        sched.trace_hook = gate
+        try:
+            warm = park_pipeline(sched, gate)
+            gate.release.set()
+            for f in warm:
+                f.result(20)
+            events = [e[0] for e in gate.events]
+            # batch 2 was STAGED while batch 1 was still executing: the
+            # second stage event lands before the first execute_end
+            second_stage = [i for i, e in enumerate(events) if e == "stage"][1]
+            first_end = events.index("execute_end")
+            assert second_stage < first_end, events
+        finally:
+            gate.release.set()
+            sched.stop()
+
+    def test_admission_overflow_sheds_oldest_explicitly(self):
+        ls, backend, sched = make_scheduler(max_pending=4)
+        gate = _Gate()
+        sched.trace_hook = gate
+        try:
+            warm = park_pipeline(sched, gate)
+            futs = [
+                sched.submit("paths", sources=("1",)) for _ in range(12)
+            ]
+            gate.release.set()
+            replied = shed = 0
+            for f in futs + warm:
+                try:
+                    f.result(20)
+                    replied += 1
+                except QueryShedError:
+                    shed += 1
+            # drop-oldest on a 4-slot queue: 8 of the 12 shed, every
+            # one of them with an explicit error — nothing unresolved
+            assert shed == 8 and replied == 7
+            assert all(f.done() for f in futs + warm)
+            counters = sched.get_counters()
+            assert counters["serving.admitted"] == 15
+            assert counters["serving.shed"] == 8
+            assert counters["serving.replies"] == 7
+            assert sched.admission.stats()["overflows"] == 8
+        finally:
+            gate.release.set()
+            sched.stop()
+
+    def test_flap_invalidates_coalesced_but_undispatched_batch(self):
+        ls, backend, sched = make_scheduler()
+        gate = _Gate()
+        sched.trace_hook = gate
+        try:
+            warm = park_pipeline(sched, gate)
+            # every parked batch pinned the pre-flap epoch; removing the
+            # 2-4 link moves the topology out from under them
+            epoch_before = int(ls.version)
+            ls.update_adjacency_database(
+                AdjacencyDatabase(
+                    this_node_name="2",
+                    adjacencies=[adj("2", "1")],
+                    is_overloaded=False,
+                    node_label=102,
+                    area="0",
+                )
+            )
+            assert int(ls.version) != epoch_before
+            gate.release.set()
+            results = [f.result(20) for f in warm]
+            # dispatch noticed the mismatch, re-pinned, recomputed fresh
+            assert sched.get_counters()["serving.invalidations"] >= 1
+            oracle = ls.get_spf_result("1")
+            for r in results:
+                assert r.epoch == int(ls.version)
+                spf = r.value["1"]
+                assert spf["4"].next_hops == oracle["4"].next_hops == {"3"}
+                assert spf["4"].metric == oracle["4"].metric
+        finally:
+            gate.release.set()
+            sched.stop()
+
+    def test_engine_refuses_moved_epoch_before_device_work(self):
+        ls = square()
+        backend = serial_backend(ls)
+        csr = backend.spf.csr_mirror(ls)
+        engine = backend.spf.engine
+        with pytest.raises(EpochMismatchError) as ei:
+            engine.spf_results(csr, ["1"], expect_epoch=int(csr.version) + 1)
+        assert ei.value.expected == int(csr.version) + 1
+        assert ei.value.actual == int(csr.version)
+        assert engine.counters["device.engine.epoch_invalidations"] == 1
+        # the matching epoch serves normally
+        res = engine.spf_results(csr, ["1"], expect_epoch=int(csr.version))
+        assert "1" in res
+
+    def test_shutdown_resolves_every_future(self):
+        ls, backend, sched = make_scheduler()
+        futs = [
+            sched.submit("paths", sources=(s,)) for s in "1234" * 8
+        ]
+        sched.stop()
+        assert all(f.done() for f in futs)
+        outcomes = {"replied": 0, "shed": 0}
+        for f in futs:
+            try:
+                f.result(0)
+                outcomes["replied"] += 1
+            except QueryShedError:
+                outcomes["shed"] += 1
+        # zero silent drops at shutdown: every future resolved, and the
+        # scheduler's own ledger agrees with what the callers saw
+        assert outcomes["replied"] + outcomes["shed"] == len(futs)
+        counters = sched.get_counters()
+        assert counters["serving.replies"] == outcomes["replied"]
+        assert counters["serving.shed"] == outcomes["shed"]
+
+    def test_counter_keys_follow_convention(self):
+        name_re = re.compile(r"[a-z][a-z0-9_]*(\.[a-z0-9_]+)+\Z")
+        assert all(name_re.match(k) for k in SERVING_COUNTER_KEYS)
+
+
+@pytest.mark.chaos
+class TestOverloadScenario:
+    """Seeded open-loop overload (tier-1 deterministic-seed variant of
+    the soak): offered load far above capacity must shed with explicit
+    errors — never drop silently — and a device fault mid-run demotes to
+    the host rung without dropping service."""
+
+    def test_overload_sheds_explicitly_and_fault_keeps_serving(self):
+        ls = square()
+        backend = EngineBatchBackend(
+            {"0": ls}, spf_backend=DeviceSpfBackend(**_DEVICE)
+        )
+        sched = QueryScheduler(backend, max_pending=16)
+        sched.run()
+        try:
+            engine = backend.spf.engine
+            gen = OpenLoopLoadGen(
+                sched,
+                nodes=["1", "2", "3", "4"],
+                seed=20260805,
+                clients=4,
+            )
+            # phase 1: burst far above a 16-slot admission queue
+            r1 = gen.run_burst(per_client=100)
+            assert r1.submitted == 400
+            assert r1.accounted == r1.submitted, "silent drop detected"
+            assert r1.shed > 0, "open-loop overload never shed"
+            assert r1.replied > 0
+            assert sched.admission.stats()["overflows"] == r1.shed
+
+            # phase 2: hard device fault on every SPF entry — the
+            # degradation ladder's host rung keeps answering
+            def fault(op: str) -> None:
+                if op == "spf":
+                    raise RuntimeError("injected device fault")
+
+            engine.fault_hook = fault
+            r2 = gen.run_burst(per_client=10)
+            engine.fault_hook = None
+            assert r2.accounted == r2.submitted, "silent drop under fault"
+            assert r2.replied > 0, "host-fallback rung stopped serving"
+
+            counters = sched.get_counters()
+            assert counters["serving.host_fallbacks"] > 0
+            # scheduler ledger == client-observed outcomes, both phases
+            assert counters["serving.shed"] == r1.shed + r2.shed
+            assert counters["serving.replies"] == r1.replied + r2.replied
+            assert counters["serving.errors"] == r1.errors + r2.errors == 0
+            # static topology: residency synced the graph exactly once
+            assert engine.counters["device.engine.full_restages"] == 1
+
+            # a post-fault reply is still bit-exact vs the host oracle
+            res = sched.submit("paths", sources=("1",)).result(20)
+            oracle = ls.get_spf_result("1")
+            assert set(res.value["1"]) == set(oracle)
+            for dest, nr in oracle.items():
+                assert res.value["1"][dest].metric == nr.metric
+                assert res.value["1"][dest].next_hops == nr.next_hops
+        finally:
+            sched.stop()
+
+
+class TestServingWire:
+    """End-to-end over both wire surfaces: the ctrl server's async query
+    methods and the thrift shim's batched-paths RPC, against a live
+    two-daemon fabric (the in-daemon DecisionBatchBackend path)."""
+
+    @pytest.fixture
+    def ring2(self):
+        from test_system import RingFixture
+
+        ring = RingFixture(2)
+        try:
+
+            def linked() -> bool:
+                for i, daemon in enumerate(ring.daemons):
+                    ls = daemon.decision.area_link_states.get("0")
+                    if ls is None or not ls.links_from_node(f"openr-{i}"):
+                        return False
+                return True
+
+            assert wait_for(linked, 30), "2-ring never formed adjacency"
+            yield ring
+        finally:
+            ring.stop()
+
+    def test_ctrl_async_query_methods(self, ring2):
+        from openr_tpu.ctrl import CtrlClient
+
+        d0 = ring2.daemons[0]
+        client = CtrlClient(port=d0.ctrl_port)
+        try:
+            reply = client.call("queryPaths", sources=["openr-0"])
+            assert reply["batchSize"] >= 1 and reply["latencyUs"] >= 0
+            spf = reply["result"]["openr-0"]
+            assert spf["openr-1"]["nextHops"] == ["openr-1"]
+            assert spf["openr-1"]["metric"] > 0
+
+            kreply = client.call(
+                "queryKsp", sources=["openr-0"], dests=["openr-1"], k=1
+            )
+            paths = kreply["result"]["openr-1"]
+            assert len(paths) == 1 and len(paths[0]) == 1
+            assert set(paths[0][0]) == {"openr-0", "openr-1"}
+
+            wreply = client.call(
+                "queryWhatIf",
+                sources=["openr-0"],
+                scenarios=[[["openr-0", "openr-1"]]],
+            )
+            row = wreply["result"][0]
+            assert row["scenario"] == 0
+            # failing the only link strands the one other node
+            assert row["newly_unreachable_pairs"] == 1
+        finally:
+            client.close()
+
+    def test_shim_query_paths_batched(self, ring2):
+        from openr_tpu.interop import thrift_binary as tb
+        from openr_tpu.interop.shim import ThriftBinaryShim
+        from test_thrift_binary import _call_ok
+
+        d0 = ring2.daemons[0]
+        admitted_before = d0.serving.get_counters()["serving.admitted"]
+        shim = ThriftBinaryShim(
+            d0.kvstore,
+            port=0,
+            node_name="openr-0",
+            serving=d0.serving,
+        )
+        shim.run()
+        try:
+            args = tb.encode_struct(
+                tb.StructSpec(
+                    "queryPathsBatched_args",
+                    None,
+                    (
+                        tb.Field(1, "sources", ("list", tb.T_STRING)),
+                        tb.Field(2, "area", tb.T_STRING),
+                    ),
+                ),
+                {"sources": ["openr-0", "openr-1"], "area": "0"},
+            )
+            dist = _call_ok(
+                shim.port,
+                "queryPathsBatched",
+                9,
+                args,
+                ("map", tb.T_STRING, ("map", tb.T_STRING, tb.T_I64)),
+                dec=lambda m: {
+                    k.decode(): {kk.decode(): vv for kk, vv in v.items()}
+                    for k, v in m.items()
+                },
+            )
+        finally:
+            shim.stop()
+            shim.wait_until_stopped(5)
+        # both sources answered from one RPC, symmetric single-link ring
+        assert dist["openr-0"]["openr-1"] > 0
+        assert dist["openr-1"]["openr-0"] == dist["openr-0"]["openr-1"]
+        # the RPC rode the scheduler (one submit per source)
+        admitted_after = d0.serving.get_counters()["serving.admitted"]
+        assert admitted_after - admitted_before == 2
+
+
+@pytest.mark.slow
+class TestServingSoak:
+    def test_open_loop_paced_soak(self):
+        ls, backend, sched = make_scheduler(max_pending=256)
+        try:
+            gen = OpenLoopLoadGen(
+                sched,
+                nodes=["1", "2", "3", "4"],
+                seed=7,
+                clients=8,
+                ops=("paths", "what_if", "ksp"),
+            )
+            report = gen.run_paced(duration_s=3.0, qps_per_client=40)
+            assert report.accounted == report.submitted
+            assert report.replied > 0 and report.qps > 0
+            assert report.mean_batch_occupancy >= 1.0
+            assert report.pctl_us(99) >= report.pctl_us(50) > 0
+        finally:
+            sched.stop()
