@@ -699,6 +699,265 @@ def bench_fleet_warm_wan100k(topo, n_prefixes: int = 1024) -> dict:
     )
 
 
+def bench_flap_storm_wan100k(
+    topo,
+    n_prefixes: int = 1024,
+    events: int = 1000,
+    chunks: int = 4,
+    seed: int = 7,
+) -> dict:
+    """Incremental delta dataflow under a seeded 1k-event flap storm
+    (round-8 tentpole).  Four high-metric (backup-grade) +1 ring links
+    flap between their base metric and 90; each chunk of 250 coalesced
+    events becomes ONE frontier certification + ONE frontier-bucketed
+    relax (ops.delta) against the resident product — never a full
+    restage.  Headline: events_per_dispatch, ms_per_event, and
+    delta_work_ratio (delta relax sweeps*columns vs the full-width cold
+    product's), with every intermediate product asserted bit-exact
+    against a cold host-oracle rebuild of that chunk's topology state.
+
+    The flappy links are HIGH-metric on purpose: a live low-metric edge
+    is the SPT parent of its endpoint for ~1/degree of ALL destination
+    columns (probed: 822/1024 here), so storms on primary links
+    correctly overflow the frontier bound and take the bit-exact full
+    fallback; backup links at the metric ceiling are tight almost
+    nowhere (probed: 29/1024 for all four worsened at once), which is
+    the regime the delta rung turns into ~P/32-width work."""
+    import jax
+    import jax.numpy as jnp
+
+    from benchmarks.synthetic import reversed_topology
+    from openr_tpu.device.engine import DeviceResidencyEngine
+    from openr_tpu.ops import allsources as asrc
+    from openr_tpu.ops import delta as dops
+    from openr_tpu.ops.banded import SpfRunner
+
+    n = topo.n_nodes
+    e = topo.n_edges
+    rev = reversed_topology(topo)
+    rng = np.random.default_rng(seed)
+    dests = np.sort(
+        rng.choice(n, size=n_prefixes, replace=False).astype(np.int32)
+    )
+    out = asrc.build_out_ell(topo.edge_src, topo.edge_dst, topo.n_edges, n)
+    runner = rev.runner
+    maps = asrc.build_epilogue_maps(runner.bg, out)
+    fwd_up = jnp.asarray(topo.edge_up)
+    fwd_ov = jnp.asarray(topo.node_overloaded)
+
+    # flappy set: 4 spread +1 ring directed edges already at the metric
+    # ceiling (10) — operationally, flap storms live on backup links
+    fsrc, fdst, fmet = topo.edge_src[:e], topo.edge_dst[:e], topo.edge_metric[:e]
+    ring10 = np.flatnonzero((fdst == (fsrc + 1) % n) & (fmet == 10))
+    flappy = [int(ring10[i * len(ring10) // 4]) for i in range(4)]
+    rsrc, rdst = rev.edge_src[:e], rev.edge_dst[:e]
+    rev_eid = {}
+    for fe in flappy:
+        m = np.flatnonzero((rsrc == fdst[fe]) & (rdst == fsrc[fe]))
+        assert len(m) == 1
+        rev_eid[fe] = int(m[0])
+
+    bg = runner.bg
+    re_ = np.asarray(bg.resid_eid)
+    be = np.asarray(bg.band_eid)
+    _, _, _, r_up, r_ov = runner.call_arrays()
+
+    # initial (pristine) cold product: the one-and-only full upload
+    dist, bitmap, ok = asrc.reduced_all_sources(
+        dests, runner, out, jnp.asarray(topo.edge_metric), fwd_up, fwd_ov,
+        maps=maps,
+    )
+    jax.block_until_ready((dist, bitmap))
+    assert bool(ok)
+    small = dist.dtype == jnp.uint16
+    dist0_h = np.asarray(dist)
+    bm0_h = np.asarray(bitmap)
+    engine = DeviceResidencyEngine()
+    engine.delta_register(dist.nbytes + bitmap.nbytes)
+
+    # denominator of delta_work_ratio: sweeps the full-width cold
+    # product needs (probe the runner's ladder once, pristine state)
+    cold_sweeps = None
+    for s in (8, 12, 16, 24, 32, 48):
+        _, _, okp = runner.run_once(
+            dests, s, want_dag=False, raw_u16=True, transpose=False
+        )
+        if bool(okp):
+            cold_sweeps = s
+            break
+    assert cold_sweeps is not None
+
+    # seeded storm event stream, replayed identically by every pass
+    ev_rng = np.random.default_rng(seed + 1)
+    per_chunk = events // chunks
+    chunk_targets = []
+    metric_now = {fe: int(fmet[fe]) for fe in flappy}
+    for _c in range(chunks):
+        for _ in range(per_chunk):
+            fe = flappy[int(ev_rng.integers(len(flappy)))]
+            metric_now[fe] = (
+                90 if int(ev_rng.integers(2)) else int(fmet[fe])
+            )
+        chunk_targets.append(dict(metric_now))
+
+    def run_storm(dist, bitmap, col_roll, verify):
+        """One full replay of the storm against (donated) dist/bitmap.
+        Returns (dist, bitmap, per-chunk stats, per-chunk ms)."""
+        r_met = np.asarray(rev.edge_metric).copy()
+        f_met = np.asarray(topo.edge_metric).copy()
+        d_roll = np.roll(dests, col_roll)
+        stats, times = [], []
+        for c in range(chunks):
+            r_new, f_new = r_met.copy(), f_met.copy()
+            for fe, m in chunk_targets[c].items():
+                r_new[rev_eid[fe]] = m
+                f_new[fe] = m
+            worse = np.flatnonzero(r_new > r_met)
+            better = np.flatnonzero(r_new < r_met)
+            w_resid = (re_ >= 0) & np.isin(re_, worse)
+            w_band = (be >= 0) & np.isin(be, worse)
+            i_resid = (re_ >= 0) & np.isin(re_, better)
+            i_band = (be >= 0) & np.isin(be, better)
+            t0 = time.perf_counter()
+            aff, col_mask, done = engine.delta_dispatch(
+                "frontier",
+                dops.delta_frontier,
+                dist,
+                bg,
+                r_up,
+                jnp.asarray(r_met),
+                r_ov,
+                jnp.asarray(w_resid),
+                jnp.asarray(w_band),
+                bg,
+                r_up,
+                jnp.asarray(r_new),
+                r_ov,
+                jnp.asarray(i_resid),
+                jnp.asarray(i_band),
+                small_dist=bool(small),
+                max_iters=128,
+            )
+            done_h, col_mask_h = jax.device_get((done, col_mask))
+            assert bool(done_h), "frontier must certify its fixpoint"
+            col_idx = np.flatnonzero(col_mask_h).astype(np.int32)
+            blocks_h, pb = 0, 0
+            if len(col_idx):
+                pb = engine.delta_bucket(len(col_idx), n_prefixes)
+                assert pb is not None, (
+                    f"chunk {c}: frontier {len(col_idx)} cols overflowed "
+                    "the bucket ladder — the storm design regressed"
+                )
+                col_pad = np.full(pb, col_idx[0], dtype=np.int32)
+                col_pad[: len(col_idx)] = col_idx
+                dist, bitmap, conv, blocks = engine.delta_dispatch(
+                    "relax",
+                    dops.delta_relax,
+                    dist,
+                    bitmap,
+                    aff,
+                    jnp.asarray(col_pad),
+                    jnp.asarray(d_roll),
+                    bg,
+                    r_up,
+                    jnp.asarray(r_new),
+                    r_ov,
+                    maps.resid_slot,
+                    maps.band_slot,
+                    depth=runner.depth,
+                    resid_rounds=runner.resid_rounds,
+                    small_dist=bool(small),
+                    chord_mode=runner.chord_mode,
+                    n_words=out.n_words,
+                    bucket_key=("relax", (n, e, n_prefixes), pb,
+                                out.n_words, bool(small)),
+                )
+                conv_h, blocks_h = jax.device_get((conv, blocks))
+                assert bool(conv_h), "delta relax must converge on device"
+                blocks_h = int(blocks_h)
+            jax.block_until_ready(dist)
+            times.append((time.perf_counter() - t0) * 1e3)
+            stats.append({"cols": int(len(col_idx)), "pb": int(pb),
+                          "blocks": blocks_h})
+            r_met, f_met = r_new, f_new
+            if verify:
+                oracle_runner = SpfRunner(
+                    rev.ell, rev.banded, rev.edge_src, rev.edge_dst,
+                    r_met, rev.edge_up, rev.node_overloaded, rev.n_edges,
+                )
+                oracle_runner.stage()
+                dist_o, bm_o, ok_o = asrc.reduced_all_sources(
+                    d_roll, oracle_runner, out, jnp.asarray(f_met),
+                    fwd_up, fwd_ov, maps=maps,
+                )
+                assert bool(ok_o)
+                assert bool(jnp.all(dist == dist_o)), (
+                    f"chunk {c}: delta product diverged from host oracle"
+                )
+                assert bool(jnp.all(bitmap == bm_o)), (
+                    f"chunk {c}: delta bitmap diverged from host oracle"
+                )
+                del dist_o, bm_o, oracle_runner
+        return dist, bitmap, stats, times
+
+    # pass A: live storm, every intermediate product verified bit-exact
+    # against a cold oracle of that chunk's topology (compiles included
+    # in its chunk times)
+    dist, bitmap, stats, times_a = run_storm(dist, bitmap, 0, verify=True)
+    # pass B: warm replay from a rolled pristine product (distinct bytes
+    # per dispatch; same programs) — the steady-state timing
+    dist_b = jax.device_put(np.roll(dist0_h, 1, axis=1))
+    bm_b = jax.device_put(np.roll(bm0_h, 1, axis=1))
+    jax.block_until_ready((dist_b, bm_b))
+    dist_b, bm_b, _, times_b = run_storm(dist_b, bm_b, 1, verify=False)
+    del dist_b, bm_b
+
+    dispatches = engine.counters["device.engine.delta_dispatches"] // 2
+    assert dispatches <= 2 * chunks, "storm exceeded its dispatch budget"
+    assert engine.counters["device.engine.full_restages"] == 1
+    assert engine.counters["device.engine.delta_overflow_fallbacks"] == 0
+    delta_sweep_cols = sum(s["blocks"] * 4 * s["pb"] for s in stats)
+    work_ratio = delta_sweep_cols / (chunks * cold_sweeps * n_prefixes)
+    assert work_ratio < 0.05, f"delta_work_ratio regressed: {work_ratio}"
+    storm_ms = min(sum(times_a), sum(times_b))
+    return {
+        "topology": topo.name,
+        "n_nodes": n,
+        "n_prefix_destinations": n_prefixes,
+        "events": events,
+        "chunks": chunks,
+        "scenario": (
+            "seeded 1k-event flap storm on 4 backup (metric-10) ring "
+            "links, coalesced into one delta chain per 250-event chunk"
+        ),
+        "events_per_dispatch": round(events / dispatches, 1),
+        "ms_per_event": round(storm_ms / events, 3),
+        "delta_work_ratio": round(work_ratio, 5),
+        "storm_ms_live": [round(t, 1) for t in times_a],
+        "storm_ms_warm": [round(t, 1) for t in times_b],
+        "frontier_cols": [s["cols"] for s in stats],
+        "bucket_pb": [s["pb"] for s in stats],
+        "relax_blocks": [s["blocks"] for s in stats],
+        "cold_sweeps": cold_sweeps,
+        "delta_dispatches": dispatches,
+        "full_restages": engine.counters["device.engine.full_restages"],
+        "overflow_fallbacks": engine.counters[
+            "device.engine.delta_overflow_fallbacks"
+        ],
+        "bytes_moved_est": None,
+        "achieved_bw_frac": None,
+        "note": (
+            "every chunk's product asserted bit-exact against a cold "
+            "host-oracle rebuild of that chunk's topology before the "
+            "next chunk ran; full_restages stays 1 (the initial upload) "
+            "and delta_work_ratio counts relax sweeps*columns vs the "
+            "full-width cold product's.  ms_per_event is min over the "
+            "live pass and a rolled-product warm replay (distinct bytes "
+            "per dispatch, replay-guard discipline)."
+        ),
+    }
+
+
 def bench_ksp_dual_metric_wan100k(topo, n_dests: int = 8) -> dict:
     """BASELINE config #3: dual-metric (IGP + TE) KSP at 100k nodes.
     Round-5 formulation: base SPF, ON-DEVICE path trace, and the masked
@@ -1703,6 +1962,8 @@ DEVICE_ROWS = {
     ),
     # round-5 warm start: flap-recovery rebuild from the previous product
     "fleet_warm_rebuild_wan100k": lambda t: bench_fleet_warm_wan100k(t.wan),
+    # round-8 incremental delta dataflow: 1k-event storm -> 8 dispatches
+    "flap_storm_wan100k": lambda t: bench_flap_storm_wan100k(t.wan),
     # BASELINE config #3: dual-metric KSP at 100k (r3 next #6)
     "ksp_dual_metric_wan100k": lambda t: bench_ksp_dual_metric_wan100k(
         t.wan

@@ -343,6 +343,36 @@ def _drive_fleet_ring(state: dict) -> None:
     assert v3 is not None and v3.converged
 
 
+def _drive_delta(state: dict) -> None:
+    """Incremental delta rung (ops.delta): frontier certification +
+    frontier-sized relax on a metric worsening, then an adjacency drop so
+    the changed out-rows re-encode (delta_rows_bitmap) runs too.  The
+    asserts keep the driver honest: a silent fallback to the full path
+    would leave the delta roots spec-less and fail the audit later with
+    a much less actionable finding."""
+    from ..decision.fleet import FleetViewCache
+    from ..device.engine import DeviceResidencyEngine
+
+    ls = _ring_link_state()
+    # full-width destination set: the frontier bound is relative to P
+    # (2 * cols <= P), so a handful of columns cannot host a delta
+    dests = [f"r{i:03d}" for i in range(64)]
+    engine = DeviceResidencyEngine()
+    cache = FleetViewCache(delta=True)
+    v1 = cache.view(ls, dests, engine=engine)
+    assert v1 is not None and v1.converged
+    # metric worsening of ONE edge -> delta_frontier + delta_relax
+    # (worsening a node's whole adjacency set drops every support of its
+    # row and the full-width frontier correctly falls back instead)
+    _update_ring_node(ls, 5, metric_fn=lambda i, j: 90 if j == 6 else 20)
+    v2 = cache.view(ls, dests, engine=engine)
+    assert v2 is not None and v2.converged and v2.warm_mode == "delta"
+    # adjacency drop -> out-slot re-rank -> delta_rows_bitmap
+    _update_ring_node(ls, 40, drop=1)
+    v3 = cache.view(ls, dests, engine=engine)
+    assert v3 is not None and v3.converged and v3.warm_mode == "delta"
+
+
 def _drive_fleet_grid_ell(state: dict) -> None:
     """Fleet product on a grid: no banded structure, so the ELL fallback
     and its fixed-sweep kernels run."""
@@ -500,6 +530,7 @@ def _drive_forward_direct(state: dict) -> None:
 DRIVERS: tuple[tuple[str, Callable[[dict], None]], ...] = (
     ("engine", _drive_engine),
     ("fleet_ring", _drive_fleet_ring),
+    ("delta", _drive_delta),
     ("fleet_grid_ell", _drive_fleet_grid_ell),
     ("allsources_legacy", _drive_allsources_legacy),
     ("ksp", _drive_ksp),

@@ -14,6 +14,7 @@ from .chaos import (
     KvChaosInjector,
     LinkFaultProfile,
 )
+from .flapstorm import FlapStormResult, FlapStormScenario
 from .overload import LoadReport, OpenLoopLoadGen
 from .scenario import ChaosScenario, fib_unicast_routes, oracle_route_dbs
 
@@ -23,6 +24,8 @@ __all__ = [
     "ChaosScenario",
     "ChaosSpfBackend",
     "FibChaosPlan",
+    "FlapStormResult",
+    "FlapStormScenario",
     "KvChaosInjector",
     "LinkFaultProfile",
     "LoadReport",

@@ -126,6 +126,7 @@ class Decision(OpenrEventBase):
         enable_best_route_selection: bool = False,
         enable_rib_policy: bool = False,
         spf_backend: Optional[SpfBackend] = None,
+        fleet_delta: Optional[bool] = None,
     ) -> None:
         super().__init__(name="decision")
         self.my_node_name = my_node_name
@@ -143,6 +144,7 @@ class Decision(OpenrEventBase):
             bgp_dry_run=bgp_dry_run,
             enable_best_route_selection=enable_best_route_selection,
             spf_backend=spf_backend,
+            fleet_delta=fleet_delta,
         )
         self.area_link_states: dict[str, LinkState] = {}
         self.prefix_state = PrefixState()
@@ -154,10 +156,24 @@ class Decision(OpenrEventBase):
         self._rebuild_debounced: Optional[AsyncDebounce] = None
         self._cold_start_pending = eor_time_s is not None
         self._ordered_fib_timeout = None
+        # topology events admitted since the last route rebuild — the
+        # serving layer's admission defer hint (QueryScheduler
+        # defer_hint): while events are pending, freshly coalesced query
+        # batches briefly hold so they pin the POST-storm epoch and ride
+        # the delta-updated product instead of dispatching against a
+        # topology about to be invalidated
+        self._pending_events = 0
         self.counters: dict[str, int] = {}
 
     def _bump(self, counter: str, n: int = 1) -> None:
         self.counters[counter] = self.counters.get(counter, 0) + n
+
+    def pending_event_hint(self) -> int:
+        """Topology events admitted but not yet folded into routes —
+        non-zero while a flap storm is mid-coalesce.  Thread-safe enough
+        for its purpose (an int read; the serving defer wait is bounded
+        either way)."""
+        return self._pending_events
 
     def get_counters(self) -> dict[str, int]:
         """Module + solver counters merged (fb303-style export)."""
@@ -198,6 +214,7 @@ class Decision(OpenrEventBase):
                 return
             self.process_publication(pub)
             if self.pending_updates.needs_route_update():
+                self._pending_events += 1
                 self._rebuild_debounced()
 
     async def _static_routes_fiber(self) -> None:
@@ -369,6 +386,9 @@ class Decision(OpenrEventBase):
         self.pending_updates.add_event("ROUTE_UPDATE")
         update.perf_events = self.pending_updates.move_out_events()
         self.pending_updates.reset()
+        # the rebuild folded every admitted event (delta rung or full):
+        # deferred query batches may pin the fresh epoch now
+        self._pending_events = 0
         self._route_updates_queue.push(update)
 
     def _compute_route_update(self) -> DecisionRouteUpdate:

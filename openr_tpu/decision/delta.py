@@ -1,0 +1,450 @@
+"""Decision-side coalescer for the incremental delta SPF rung.
+
+`DeltaProductUpdater` folds every LinkState mutation that landed since
+the previous converged fleet view — k pending events (adj up/down,
+metric change, overload flip) — into ONE batched frontier certification
++ ONE frontier-sized relax through `DeviceResidencyEngine.delta_dispatch`
+(openr_tpu.ops.delta kernels), instead of k (or even one) full [N, P]
+fused products.  Work on device is proportional to the affected columns,
+not k*N*P.
+
+The safety story is entirely the existing warm-start machinery,
+generalized to MIXED batches:
+
+- worsened slots (removed/metric-increased pairs, newly-drained transit)
+  seed the certified tight-chain propagation over the OLD graph
+  (decision.fleet._worsened_masks -> ops.banded.affected_mask);
+- improved slots (new/metric-decreased pairs, un-drained transit) are
+  checked by firing the NEW graph's exact relax candidates at those
+  slots against the old distances (`_improved_masks`, NEW layout);
+  `cand <= d` — an equality-creating improvement moves the ECMP bitmap
+  without moving the distance, so equality must mark the column too;
+- every destination column outside either set is PROVEN unchanged and
+  keeps its old device column verbatim; flagged columns re-relax from
+  the `_affected_init` upper bound and re-certify on device.
+
+Every gate failure — uncertified propagation, frontier over the bucket
+ladder (engine.delta_bucket -> None), dtype/layout drift, non-converged
+relax — falls back to the legacy full path by returning False: the
+caller (FleetViewCache.view) then runs exactly the code it would have
+run without this module, which is the bit-exact fallback the tentpole
+requires.  An optional parity gate (OPENR_DELTA_PARITY=1) recomputes
+the full cold product after every delta update and adopts it on any
+mismatch, bumping decision.delta.parity_failures.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+import numpy as np
+
+from .fleet import (
+    FleetRouteView,
+    _in_sorted,
+    _reverse_runner,
+    _worsened_masks,
+)
+
+log = logging.getLogger(__name__)
+
+# pre-seeded into SpfSolver.counters so both wire surfaces (ctrl handler
+# + fb303 shim) expose the family from daemon start (counter-hygiene
+# discipline: registered keys are bumped via these exact literals below)
+DELTA_COUNTER_KEYS = (
+    "decision.delta.updates",
+    "decision.delta.noop_updates",
+    "decision.delta.events_coalesced",
+    "decision.delta.dispatches",
+    "decision.delta.affected_cols",
+    "decision.delta.fallbacks",
+    "decision.delta.parity_checks",
+    "decision.delta.parity_failures",
+)
+
+
+def _improved_masks(prev: FleetRouteView, new: FleetRouteView, new_runner):
+    """Per-reverse-slot masks of IMPROVED forward edges, in the layout of
+    the NEW view's reverse runner — the improvement-direction mirror of
+    decision.fleet._worsened_masks (which marks worsened slots in the
+    OLD layout).
+
+    Improved means the edge can only SHORTEN paths or create new ties:
+    - usable directed pair absent from the old table (link/adjacency up),
+    - pair present with a smaller min metric,
+    - transit through a node that DROPPED its overload bit: every
+      reverse slot whose neighbor is that node regained its relax-allow
+      (conservatively including the destination-row exception — over-
+      marking improved slots only adds candidate checks, never error).
+    The NEW layout is the right frame: these slots exist in the new
+    graph (a brand-new pair has no old slot at all), and the frontier
+    kernel evaluates their NEW exact candidates against the old
+    distances (ops.delta.delta_frontier)."""
+    old_keys, old_met = prev._edge_keys, prev._edge_met
+    new_keys, new_met = new._edge_keys, new._edge_met
+    present = _in_sorted(old_keys, new_keys)
+    better = ~present
+    if len(old_keys):
+        pos = np.minimum(
+            np.searchsorted(old_keys, new_keys), len(old_keys) - 1
+        )
+        better |= present & (new_met < old_met[pos])
+    good_keys = new_keys[better]  # sorted (subset of sorted new_keys)
+    ov_drop = prev._overloaded & ~new._overloaded
+    bg = new_runner.bg
+    n = bg.n_nodes
+    rn = np.asarray(bg.resid_nbr)
+    re_ = np.asarray(bg.resid_eid)
+    v_ids = np.arange(n, dtype=np.int64)
+    # reverse slot (v, k) with neighbor u is forward edge v -> u
+    qk = (v_ids[:, None] << 32) | rn.astype(np.int64)
+    improved_resid = (re_ >= 0) & (_in_sorted(good_keys, qk) | ov_drop[rn])
+    be = np.asarray(bg.band_eid)
+    rows = []
+    for b, c in enumerate(bg.offsets):
+        u = (v_ids - c) % n
+        qk = (v_ids << 32) | u
+        rows.append((be[b] >= 0) & (_in_sorted(good_keys, qk) | ov_drop[u]))
+    return improved_resid, np.stack(rows)
+
+
+def _changed_out_rows(prev_out, new_out) -> Optional[np.ndarray]:
+    """Node ids whose OutEll row content changed — their bitmap words
+    need re-encoding even when no route changed, because OutEll.slot is
+    the rank among sorted unique out-neighbors and gaining/losing an
+    out-edge (even a DOWN one) re-ranks the survivors.  Returns None
+    when the table shapes diverged (caller falls back); row-order drift
+    inside a node only over-marks (re-encoding an unchanged row is
+    idempotent)."""
+    on, nn = np.asarray(prev_out.nbr), np.asarray(new_out.nbr)
+    oe, ne = np.asarray(prev_out.eid), np.asarray(new_out.eid)
+    os_, ns = np.asarray(prev_out.slot), np.asarray(new_out.slot)
+    if on.shape != nn.shape:
+        return None
+    ov, nv = oe >= 0, ne >= 0
+    diff = (ov != nv) | (nv & ((on != nn) | (os_ != ns)))
+    return np.flatnonzero(diff.any(axis=1)).astype(np.int32)
+
+
+class DeltaProductUpdater:
+    """One attempt = one coalesced event batch folded into the previous
+    view's device product, or False (caller takes the legacy path)."""
+
+    def __init__(
+        self,
+        bump=None,
+        min_p: int = 32,
+        parity: Optional[bool] = None,
+        max_iters: int = 128,
+    ) -> None:
+        # counter sink (SpfSolver._bump); None is a no-op sink so the
+        # updater works engine-style in tests/bench without a solver
+        self._bump_fn = bump
+        # below this product width the full fused product is already a
+        # single cheap dispatch — the bucket ladder has no room to win
+        self.min_p = min_p
+        self.max_iters = max_iters
+        if parity is None:
+            parity = os.environ.get("OPENR_DELTA_PARITY", "0") == "1"
+        self.parity = parity
+        # last-update work attribution, read by bench/chaos:
+        # (relax while-loop blocks, padded column bucket) or None
+        self.last_blocks: Optional[int] = None
+        self.last_pb: Optional[int] = None
+        self.last_cols: int = 0
+
+    def _bump(self, name: str, delta: int = 1) -> None:
+        if self._bump_fn is not None:
+            self._bump_fn(name, delta)
+
+    # -- gates ---------------------------------------------------------------
+
+    def eligible(self, prev: Optional[FleetRouteView]) -> bool:
+        """Cheap host-only screen over the PREVIOUS view — the full
+        update() re-checks everything it needs; this exists so callers
+        can skip building masks for hopeless cases."""
+        return (
+            prev is not None
+            and prev.converged
+            and prev._dist_dev is not None
+            and prev._bitmap_dev is not None
+            and prev._runner is not None
+            and prev._runner.bg is not None
+            and prev._out is not None
+            and len(prev.dest_names) >= self.min_p
+        )
+
+    # -- the update ----------------------------------------------------------
+
+    def update(self, prev: FleetRouteView, view: FleetRouteView, engine) -> bool:
+        """Fold the prev->view LinkState delta into prev's device product
+        and finalize `view` from it (warm_mode == "delta").  False means
+        nothing was changed and the caller must run the legacy path; the
+        ONE exception is a post-donation relax failure, which kills
+        prev's arrays (prev.converged flips False so the legacy warm
+        gates skip it and the rebuild goes cold — correct, one extra
+        cold run)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops import allsources as asrc
+        from ..ops import delta as dops
+
+        if engine is None or not self.eligible(prev):
+            return False
+        if (
+            prev.dest_names != view.dest_names
+            or prev._node_id != view._node_id
+            or prev._overloaded.shape != view._overloaded.shape
+        ):
+            return False  # universe changed: columns are not comparable
+        csr = view.csr
+        prev_small = prev._dist_dev.dtype == np.uint16
+        try:
+            runner = _reverse_runner(csr)
+        except Exception:
+            log.warning("delta: reverse runner build failed", exc_info=True)
+            self._bump("decision.delta.fallbacks")
+            return False
+        if runner.bg is None or runner.small_dist != prev_small:
+            # no band structure, or the distance dtype must change
+            # (saturation risk either way): donation-in-place is off
+            self._bump("decision.delta.fallbacks")
+            return False
+        out = asrc.build_out_ell(
+            csr.edge_src,
+            csr.edge_dst,
+            csr.n_edges,
+            csr.n_nodes,
+            out_slot=csr.out_slot,
+        )
+        if out.n_words != prev._out.n_words:
+            self._bump("decision.delta.fallbacks")
+            return False
+        changed_rows = _changed_out_rows(prev._out, out)
+        if changed_rows is None or 2 * len(changed_rows) > csr.n_nodes:
+            # out-table shape drift, or so many rows re-ranked the row
+            # re-encode would rival a full bitmap pass
+            self._bump("decision.delta.fallbacks")
+            return False
+        events = max(1, int(view.version) - int(prev.version))
+
+        worsened_resid, worsened_band = _worsened_masks(
+            prev, view._edge_keys, view._edge_met, view._overloaded
+        )
+        improved_resid, improved_band = _improved_masks(prev, view, runner)
+
+        p = len(view.dest_names)
+        epoch = int(csr.version)
+        _, _, o_met, o_up, o_ov = prev._runner.call_arrays()
+        _, _, n_met, n_up, n_ov = runner.call_arrays()
+        topo_key = (csr.n_nodes, csr.n_edges, p)
+        try:
+            aff, col_mask, done = engine.delta_dispatch(
+                "frontier",
+                dops.delta_frontier,
+                prev._dist_dev,
+                prev._runner.bg,
+                o_up,
+                o_met,
+                o_ov,
+                jnp.asarray(worsened_resid),
+                jnp.asarray(worsened_band),
+                runner.bg,
+                n_up,
+                n_met,
+                n_ov,
+                jnp.asarray(improved_resid),
+                jnp.asarray(improved_band),
+                small_dist=prev_small,
+                max_iters=self.max_iters,
+                csr=csr,
+                expect_epoch=epoch,
+            )
+            self._bump("decision.delta.dispatches")
+            # one fused fetch: the certification verdict + the column
+            # frontier drive host control flow (bucket pick / fallback)
+            done_h, col_mask_h = jax.device_get((done, col_mask))
+        except Exception:
+            log.warning("delta: frontier dispatch failed", exc_info=True)
+            self._bump("decision.delta.fallbacks")
+            return False
+        if not bool(done_h):
+            # propagation ran out of iterations before its fixpoint: an
+            # under-propagated frontier is silently wrong — fall back
+            self._bump("decision.delta.fallbacks")
+            return False
+        col_idx = np.flatnonzero(col_mask_h).astype(np.int32)
+        n_cols = len(col_idx)
+        self.last_cols = n_cols
+        if n_cols == 0 and len(changed_rows) == 0:
+            # certified no-op: every column keeps its proof, every bitmap
+            # row keeps its encoding — adopt the previous arrays verbatim
+            self._adopt(prev, view, runner, out, prev._dist_dev,
+                        prev._bitmap_dev)
+            self.last_blocks, self.last_pb = 0, 0
+            self._bump("decision.delta.noop_updates")
+            self._bump("decision.delta.events_coalesced", events)
+            return True
+
+        new_dist, new_bm = prev._dist_dev, prev._bitmap_dev
+        blocks_h = 0
+        pb = 0
+        if n_cols:
+            pb = engine.delta_bucket(n_cols, p)
+            if pb is None:
+                # frontier bound exceeded — the full fused product is
+                # the cheaper (and bit-exact) program for this batch
+                self._bump("decision.delta.fallbacks")
+                return False
+            col_pad = np.full(pb, col_idx[0], dtype=np.int32)
+            col_pad[:n_cols] = col_idx
+            dest_ids = np.asarray(
+                [view._node_id[d] for d in view.dest_names], dtype=np.int32
+            )
+            maps = asrc.build_epilogue_maps(runner.bg, out)
+            try:
+                new_dist, new_bm, conv, blocks = engine.delta_dispatch(
+                    "relax",
+                    dops.delta_relax,
+                    new_dist,
+                    new_bm,
+                    aff,
+                    jnp.asarray(col_pad),
+                    jnp.asarray(dest_ids),
+                    runner.bg,
+                    n_up,
+                    n_met,
+                    n_ov,
+                    maps.resid_slot,
+                    maps.band_slot,
+                    depth=runner.depth,
+                    resid_rounds=runner.resid_rounds,
+                    small_dist=prev_small,
+                    chord_mode=runner.chord_mode,
+                    n_words=out.n_words,
+                    csr=csr,
+                    expect_epoch=epoch,
+                    bucket_key=(
+                        "relax", topo_key, pb, out.n_words, prev_small,
+                        runner.depth, runner.chord_mode,
+                    ),
+                )
+                self._bump("decision.delta.dispatches")
+                conv_h, blocks_h = jax.device_get((conv, blocks))
+            except Exception:
+                log.warning("delta: relax dispatch failed", exc_info=True)
+                self._kill(prev)
+                self._bump("decision.delta.fallbacks")
+                return False
+            finally:
+                # the relax DONATED prev's buffers: dead either way
+                prev._dist_dev = None
+                prev._bitmap_dev = None
+                prev._rows = {}
+            if not bool(conv_h):
+                # block budget ran out without the on-device certificate
+                self._kill(prev)
+                self._bump("decision.delta.fallbacks")
+                return False
+        if len(changed_rows):
+            rb = 1
+            while rb < len(changed_rows):
+                rb *= 2
+            row_pad = np.full(rb, changed_rows[0], dtype=np.int32)
+            row_pad[: len(changed_rows)] = changed_rows
+            try:
+                new_bm = engine.delta_dispatch(
+                    "rows_bitmap",
+                    dops.delta_rows_bitmap,
+                    new_bm,
+                    new_dist,
+                    jnp.asarray(row_pad),
+                    out.nbr,
+                    out.eid,
+                    out.slot,
+                    jnp.asarray(csr.edge_metric),
+                    jnp.asarray(csr.edge_up),
+                    jnp.asarray(csr.node_overloaded),
+                    n_words=out.n_words,
+                    csr=csr,
+                    expect_epoch=epoch,
+                    bucket_key=("rows", topo_key, rb, out.n_words),
+                )
+                self._bump("decision.delta.dispatches")
+            except Exception:
+                log.warning("delta: row re-encode failed", exc_info=True)
+                self._kill(prev)
+                self._bump("decision.delta.fallbacks")
+                return False
+            finally:
+                prev._dist_dev = None
+                prev._bitmap_dev = None
+                prev._rows = {}
+
+        self._adopt(prev, view, runner, out, new_dist, new_bm)
+        self.last_blocks = int(blocks_h)
+        self.last_pb = int(pb)
+        self._bump("decision.delta.updates")
+        self._bump("decision.delta.events_coalesced", events)
+        self._bump("decision.delta.affected_cols", n_cols)
+        if self.parity:
+            self._parity_gate(view)
+        return True
+
+    # -- internals -----------------------------------------------------------
+
+    @staticmethod
+    def _kill(prev: FleetRouteView) -> None:
+        """Post-donation failure: prev's device arrays are gone, so mark
+        it unusable for the legacy warm gates (they require converged +
+        live arrays) — the rebuild then cold-starts, which is correct."""
+        prev._dist_dev = None
+        prev._bitmap_dev = None
+        prev._rows = {}
+        prev.converged = False
+
+    def _adopt(self, prev, view, runner, out, dist, bitmap) -> None:
+        view._dist_dev = dist
+        view._bitmap_dev = bitmap
+        view._out = out
+        view._runner = runner
+        view.converged = True
+        view.warm = True
+        view.warm_mode = "delta"
+        # the delta path never learns a cold sweep budget; carry the
+        # previous view's so a later cold rebuild keeps its head start
+        view.sweep_hint = prev.sweep_hint
+        prev._dist_dev = None
+        prev._bitmap_dev = None
+        prev._rows = {}
+
+    def _parity_gate(self, view: FleetRouteView) -> None:
+        """Host-oracle parity: recompute the full cold product for the
+        same snapshot and require bit-exact equality.  On mismatch the
+        oracle's arrays replace the delta result (serve correct routes)
+        and parity_failures records the bug."""
+        import jax
+
+        self._bump("decision.delta.parity_checks")
+        oracle = FleetRouteView(view.csr, view.dest_names)
+        oracle.compute()
+        d_a, b_a = jax.device_get((view._dist_dev, view._bitmap_dev))
+        d_o, b_o = jax.device_get((oracle._dist_dev, oracle._bitmap_dev))
+        n = oracle._runner.bg.n_nodes if oracle._runner.bg is not None else (
+            d_o.shape[0]
+        )
+        if (
+            d_a.dtype != d_o.dtype
+            or not np.array_equal(d_a[:n], d_o[:n])
+            or not np.array_equal(b_a, b_o)
+        ):
+            log.error("delta: parity gate FAILED; adopting oracle product")
+            self._bump("decision.delta.parity_failures")
+            view._dist_dev = oracle._dist_dev
+            view._bitmap_dev = oracle._bitmap_dev
+            view._out = oracle._out
+            view._runner = oracle._runner
+            view._rows = {}

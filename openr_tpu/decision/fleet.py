@@ -491,9 +491,35 @@ def fleet_destinations(ls: LinkState, prefix_state) -> list[str]:
 class FleetViewCache:
     """Per-LinkState cached FleetRouteView, invalidated on topology
     version or destination-set change.  Weakly keyed like
-    DeviceSpfBackend's mirrors (ids recycle after GC)."""
+    DeviceSpfBackend's mirrors (ids recycle after GC).
 
-    def __init__(self) -> None:
+    `delta` opts in to the incremental delta rung (decision.delta +
+    ops.delta through the engine's delta_dispatch): a rebuild over the
+    same universe first tries to fold the whole pending event batch into
+    the previous device product at frontier-proportional cost, falling
+    back to the legacy warm/cold paths below on any gate failure.
+    Default OFF (None reads OPENR_FLEET_DELTA): the rung re-labels
+    warm_mode and shifts counters, so existing deployments and the
+    warm-path tests keep their exact behavior unless asked."""
+
+    def __init__(
+        self,
+        delta: Optional[bool] = None,
+        bump=None,
+        delta_min_p: int = 32,
+        delta_parity: Optional[bool] = None,
+    ) -> None:
+        import os
+
+        if delta is None:
+            delta = os.environ.get("OPENR_FLEET_DELTA", "0") == "1"
+        self._delta = None
+        if delta:
+            from .delta import DeltaProductUpdater
+
+            self._delta = DeltaProductUpdater(
+                bump=bump, min_p=delta_min_p, parity=delta_parity
+            )
         self._views: "weakref.WeakKeyDictionary[LinkState, FleetRouteView]" = (
             weakref.WeakKeyDictionary()
         )
@@ -545,6 +571,18 @@ class FleetViewCache:
             csr.refresh(ls)
         prev = self._views.get(ls)
         view = FleetRouteView(csr, dest_names, engine=engine)
+        # incremental rung first: fold the whole pending event batch
+        # into the previous device product at frontier-proportional
+        # cost; any gate failure falls through to the legacy warm/cold
+        # paths below, which are the bit-exact fallback
+        if (
+            self._delta is not None
+            and engine is not None
+            and self._delta.eligible(prev)
+            and self._delta.update(prev, view, engine)
+        ):
+            self._views[ls] = view
+            return view
         key = (csr.n_nodes, csr.n_edges)
         init_from = None
         down_from = None

@@ -36,6 +36,7 @@ from ..types import (
     UnicastRoute,
     normalize_prefix,
 )
+from .delta import DELTA_COUNTER_KEYS
 from .fleet import (
     INF32 as FLEET_INF,
     FleetRouteView,
@@ -480,6 +481,7 @@ class SpfSolver:
         bgp_dry_run: bool = False,
         enable_best_route_selection: bool = False,
         spf_backend: Optional[SpfBackend] = None,
+        fleet_delta: Optional[bool] = None,
     ) -> None:
         self.my_node_name = my_node_name
         self.enable_v4 = enable_v4
@@ -491,15 +493,21 @@ class SpfSolver:
         # route correctness is never hostage to the accelerator
         self._host_fallback: Optional[HostSpfBackend] = None
         # fleet-product views (reduced all-sources reverse-SSSP consumer;
-        # active per build via build_route_db(fleet_views=...))
-        self.fleet = FleetViewCache()
+        # active per build via build_route_db(fleet_views=...)).
+        # `fleet_delta` opts in to the incremental delta rung
+        # (decision.delta): None keeps the FleetViewCache default
+        # (OPENR_FLEET_DELTA env), so direct constructions stay on the
+        # legacy paths unless the daemon asks.
+        self.fleet = FleetViewCache(delta=fleet_delta, bump=self._bump)
         self._fleet_views: dict[str, FleetRouteView] = {}
         # static route overlays (reference: Decision.cpp:372-425)
         self.static_unicast_routes: dict[str, list[NextHop]] = {}
         self.static_mpls_routes: dict[int, list[NextHop]] = {}
         # best-route selection cache (reference: bestRoutesCache_)
         self.best_routes_cache: dict[str, BestRouteSelectionResult] = {}
-        self.counters: dict[str, int] = {}
+        # the decision.delta.* family is pre-seeded so both wire surfaces
+        # expose it from daemon start even before the rung ever engages
+        self.counters: dict[str, int] = {k: 0 for k in DELTA_COUNTER_KEYS}
 
     def _bump(self, counter: str, n: int = 1) -> None:
         self.counters[counter] = self.counters.get(counter, 0) + n

@@ -58,7 +58,17 @@ ENGINE_COUNTER_KEYS = (
     "device.engine.compile_us",
     "device.engine.dispatch_us",
     "device.engine.epoch_invalidations",
+    "device.engine.delta_dispatches",
+    "device.engine.delta_dispatch_us",
+    "device.engine.delta_bucket_hits",
+    "device.engine.delta_bucket_misses",
+    "device.engine.delta_overflow_fallbacks",
 )
+
+# affected-column padding ladder for the delta rung: a frontier of
+# n_cols columns dispatches at the smallest rung >= n_cols so storms of
+# similar size share one compiled program
+DELTA_P_BUCKETS = (8, 16, 32, 64, 128, 256, 512)
 
 
 class EpochMismatchError(RuntimeError):
@@ -240,6 +250,8 @@ class DeviceResidencyEngine:
         self._program_specs: dict[tuple, tuple] = {}
         # id(csr) -> _Resident (csr mirrors are long-lived per area)
         self._residents: dict[int, _Resident] = {}
+        # delta-rung bucket cells already traced (hit/miss accounting)
+        self._delta_buckets_seen: set = set()
         # chaos seam: called with an op name at every engine entry point
         self.fault_hook: Optional[Callable[[str], None]] = None
         # per-query attribution (read by bench rows)
@@ -542,5 +554,78 @@ class DeviceResidencyEngine:
             self._bump("device.engine.dispatches")
             self._bump(
                 "device.engine.dispatch_us",
+                int((time.perf_counter() - t0) * 1e6),
+            )
+
+    # -- delta rung ----------------------------------------------------------
+
+    def delta_bucket(self, n_cols: int, p: int) -> Optional[int]:
+        """Padded slab width for an affected frontier of `n_cols` columns
+        out of a `p`-wide product, or None when the frontier bound is
+        exceeded (bucket >= p, or the frontier covers more than half the
+        product — at that point the full fused product is cheaper and is
+        the bit-exact fallback the caller must take)."""
+        if n_cols <= 0:
+            return None
+        if 2 * n_cols > p:
+            self._bump("device.engine.delta_overflow_fallbacks")
+            return None
+        for b in DELTA_P_BUCKETS:
+            if n_cols <= b:
+                if b >= p:
+                    self._bump("device.engine.delta_overflow_fallbacks")
+                    return None
+                return b
+        self._bump("device.engine.delta_overflow_fallbacks")
+        return None
+
+    def delta_register(self, nbytes: int) -> None:
+        """Account the one full product upload a delta sequence starts
+        from — the acceptance invariant is full_restages == 1 across a
+        whole storm, everything after rides the donated delta slabs."""
+        self._bump("device.engine.full_restages")
+        self._bump("device.engine.bytes_staged", int(nbytes))
+
+    def delta_dispatch(
+        self,
+        op: str,
+        fn: Callable,
+        *args,
+        csr=None,
+        expect_epoch: Optional[int] = None,
+        bucket_key: Optional[tuple] = None,
+        **kwargs,
+    ):
+        """Dispatch front-end for the incremental delta rung.
+
+        Same chaos-hook + timing contract as `dispatch`, plus: an epoch
+        pin (`expect_epoch` against `csr.version`, checked BEFORE device
+        work so the serving coalescer's retry loop composes — a flap
+        between coalescing and dispatch re-coalesces instead of relaxing
+        a stale frontier) and bucket-ladder accounting (`bucket_key`
+        identifies the compiled-program cell; first sighting is a miss =
+        a compile, repeats are hits)."""
+        if self.fault_hook is not None:
+            self.fault_hook("delta_" + op)
+        if (
+            expect_epoch is not None
+            and csr is not None
+            and int(csr.version) != int(expect_epoch)
+        ):
+            self._bump("device.engine.epoch_invalidations")
+            raise EpochMismatchError(int(expect_epoch), int(csr.version))
+        if bucket_key is not None:
+            if bucket_key in self._delta_buckets_seen:
+                self._bump("device.engine.delta_bucket_hits")
+            else:
+                self._delta_buckets_seen.add(bucket_key)
+                self._bump("device.engine.delta_bucket_misses")
+        t0 = time.perf_counter()
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            self._bump("device.engine.delta_dispatches")
+            self._bump(
+                "device.engine.delta_dispatch_us",
                 int((time.perf_counter() - t0) * 1e6),
             )

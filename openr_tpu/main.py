@@ -196,6 +196,11 @@ class OpenrDaemon:
             enable_best_route_selection=config.enable_best_route_selection,
             enable_rib_policy=config.enable_rib_policy,
             spf_backend=backend,
+            # the incremental delta rung needs an engine to dispatch
+            # through; daemons running the device backend get it, forced
+            # pure-host daemons keep the legacy paths (it would only
+            # gate-fail per rebuild).  Inert below delta_min_p dests.
+            fleet_delta=use_device_spf if spf_backend is None else None,
         )
 
         # -- fib (reference: Main.cpp:533-545) -------------------------------
@@ -317,7 +322,12 @@ class OpenrDaemon:
         # (serving.DecisionBatchBackend), so Decision must already be up
         from .serving import DecisionBatchBackend, QueryScheduler
 
-        self.serving = QueryScheduler(DecisionBatchBackend(self.decision))
+        self.serving = QueryScheduler(
+            DecisionBatchBackend(self.decision),
+            # hold freshly coalesced batches (bounded) while topology
+            # events are mid-fold, so they pin the post-storm epoch
+            defer_hint=self.decision.pending_event_hint,
+        )
         self.serving.run()
         if self.watchdog is not None:
             self.watchdog.add_evb(self.serving)

@@ -1,0 +1,455 @@
+"""Batched delta propagation for the reduced all-sources product.
+
+A flap storm of k LinkState events today costs k (or, coalesced, still
+full-width) [N, P] fleet products even though each event perturbs a
+tiny frontier.  These kernels process ONE COALESCED BATCH of events as
+two device programs whose relax work is proportional to the affected
+frontier, not k*N*P:
+
+1. `delta_frontier` — certify, on device, which (router, dest) entries
+   of the previous converged product a batch of edge/node deltas can
+   possibly have changed.  The worsening direction runs the EXACT
+   support-loss rule over the OLD graph's shortest-path DAG: an entry
+   is affected iff EVERY tight support (a slot achieving candidate
+   equality) is either itself worsened or leads to an affected
+   neighbor.  This is the sharp refinement of `affected_mask`'s
+   ANY-tight-chain OR-rule — under ECMP permutation ties a worsened
+   edge is tight almost everywhere, but a row that keeps ONE intact
+   support keeps its distance, so the AND-rule is what stops a flap
+   storm from saturating the column frontier.  Tight supports strictly
+   decrease the distance (positive metrics), so the support graph is
+   acyclic and the monotone fixpoint is exact, not heuristic: every
+   unaffected entry retains an intact support chain of unworsened
+   edges down to its source, hence its old value survives in the new
+   graph.  The improvement direction fires the NEW graph's exact relax
+   candidates at the improved slots against the old distances — a
+   candidate with cand <= d (note: <=, an equality-creating improvement
+   changes the ECMP bitmap without moving the distance) marks its
+   column.  A destination column outside the union is PROVEN unchanged.
+
+2. `delta_relax` — gather ONLY the affected destination columns (padded
+   to a frontier-size bucket), re-relax them under the progressive
+   on-device while_loop with the affected entries re-set to INF (the
+   `_affected_init` upper-bound argument, per column), run the fused
+   verify+bitmap epilogue over the [N, Pb] slab, and write the columns
+   back into the DONATED full-width product with a scatter-free
+   hit-matrix select.  A converged delta round equals the cold full
+   product bit-for-bit on every column.
+
+3. `delta_rows_bitmap` — after an edge-SET change, a node that gained
+   or lost an out-neighbor has its per-slot bit ENCODING shifted
+   (OutEll.slot is the rank among sorted unique out-neighbors) even for
+   destination columns whose routes did not change.  This kernel
+   re-encodes just those rows' bitmap words across all P columns from
+   the (already exact) distances — the same LFA-free condition as
+   `ecmp_bitmap_from_reverse_dist`, restricted to a bucketed row set,
+   written back through the donated bitmap.
+
+The decision-layer coalescer (openr_tpu.decision.delta) folds the k
+pending events into the host-built slot masks these kernels consume and
+falls back, bit-exactly, to the full fused product whenever the
+frontier bound is exceeded or certification fails.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .sssp import INF16, INF32, clamp_metric_u16, u16_saturation_verdict
+
+
+@functools.partial(
+    jax.jit, static_argnames=("small_dist", "max_iters")
+)
+def delta_frontier(
+    dist: jax.Array,  # [N*, P] — previous CONVERGED reverse product
+    old_bg,  # previous topology's banded decomposition
+    o_edge_up: jax.Array,  # previous reverse runtime arrays (OLD graph)
+    o_edge_metric: jax.Array,
+    o_node_overloaded: jax.Array,
+    worsened_resid: jax.Array,  # [N, K_old] bool — OLD-layout worsened slots
+    worsened_band: jax.Array,  # [B_old, N] bool
+    new_bg,  # new topology's banded decomposition
+    n_edge_up: jax.Array,  # new reverse runtime arrays (NEW graph)
+    n_edge_metric: jax.Array,
+    n_node_overloaded: jax.Array,
+    improved_resid: jax.Array,  # [N, K_new] bool — NEW-layout improved slots
+    improved_band: jax.Array,  # [B_new, N] bool
+    small_dist: bool = False,
+    max_iters: int = 128,
+):
+    """Certified affected frontier of one coalesced event batch.
+
+    Returns (aff [N, P] bool, col_mask [P] bool, done bool):
+    - aff: entries whose old value the WORSENED edges invalidated — the
+      exact support-loss set: a row is affected iff every OLD tight
+      support is worsened or leads to an affected neighbor (AND-rule
+      over the acyclic tight-support DAG; see the module docstring).
+    - col_mask: destination columns needing re-relax — any affected
+      entry, OR any WORSENED slot that was tight (the row may keep its
+      distance through an intact alternative, but the worsened slot's
+      ECMP bit turns off — a route change with no distance change), OR
+      any improved slot whose NEW exact candidate fires at cand <= d
+      (strict improvements move distances; equality-creating ones move
+      only the ECMP bitmap, hence <=).
+    - done: the support-loss fixpoint was reached within max_iters;
+      False means the caller MUST fall back to the full product (an
+      under-propagated set is silently wrong).
+
+    Source rows can never mark themselves (d == 0 is guarded, and a
+    candidate into a pinned 0-distance source is >= 1), so dest
+    re-pinning stays delta_relax's job.  Cost: bool-matrix sweeps plus
+    two candidate passes — no [N, P] distance mutation happens here.
+    """
+    from .banded import _RelaxOps
+
+    n = old_bg.n_nodes
+    old_ops = _RelaxOps(
+        old_bg,
+        o_edge_up,
+        o_edge_metric,
+        o_node_overloaded[:n],
+        0,
+        1,
+        None,
+        small_dist,
+        False,
+        dist.dtype,
+    )
+    d_old = dist[:n]
+    fin = d_old < old_ops.inf
+
+    # bitmap-only seeds: a worsened slot that was tight had its ECMP
+    # bit ON; even when the row keeps its distance through an intact
+    # alternative support, that bit must turn OFF — the column needs
+    # the re-relax epilogue's re-encode
+    bit_off = jnp.zeros(d_old.shape, dtype=jnp.bool_)
+    for k in range(old_ops.n_resid):
+        tight = fin & (old_ops.resid_cand(d_old, k) == d_old)
+        bit_off = bit_off | (tight & worsened_resid[:, k][:, None])
+    for b in range(old_ops.n_bands):
+        tight = fin & (old_ops.band0_cand(d_old, b) == d_old)
+        bit_off = bit_off | (tight & worsened_band[b][:, None])
+
+    def sweep(aff):
+        # a row keeps its old value iff SOME tight support survives:
+        # an unworsened slot whose supporting neighbor is unaffected
+        intact = jnp.zeros(d_old.shape, dtype=jnp.bool_)
+        for k in range(old_ops.n_resid):
+            tight = fin & (old_ops.resid_cand(d_old, k) == d_old)
+            intact = intact | (
+                tight
+                & ~worsened_resid[:, k][:, None]
+                & ~jnp.take(aff, old_bg.resid_nbr[:, k], axis=0)
+            )
+        for b, c in enumerate(old_bg.offsets):
+            tight = fin & (old_ops.band0_cand(d_old, b) == d_old)
+            intact = intact | (
+                tight
+                & ~worsened_band[b][:, None]
+                & ~jnp.roll(aff, c, axis=0)
+            )
+        return fin & (d_old > 0) & ~intact
+
+    def body(state):
+        aff, _, i = state
+        new = sweep(aff)
+        return new, jnp.all(new == aff), i + jnp.int32(1)
+
+    def cond(state):
+        _, settled, i = state
+        return jnp.logical_and(~settled, i < max_iters)
+
+    aff, done, _ = jax.lax.while_loop(
+        cond,
+        body,
+        (
+            jnp.zeros(d_old.shape, dtype=jnp.bool_),
+            jnp.bool_(False),
+            jnp.int32(0),
+        ),
+    )
+
+    n = new_bg.n_nodes
+    d = dist[:n]
+    new_ops = _RelaxOps(
+        new_bg,
+        n_edge_up,
+        n_edge_metric,
+        n_node_overloaded[:n],
+        0,
+        1,
+        None,
+        small_dist,
+        False,
+        d.dtype,
+    )
+    # improvement firing: evaluate the NEW exact depth-0 candidates at
+    # the improved slots only — unchanged slots cannot fire below the
+    # old fixed point and worsened slots only raised their candidates,
+    # so these are the only places a new (shorter or newly-tight) path
+    # can enter
+    fire = jnp.zeros(d.shape, dtype=jnp.bool_)
+    for k in range(new_ops.n_resid):
+        cand = new_ops.resid_cand(d, k)
+        fire = fire | (
+            improved_resid[:, k][:, None]
+            & (cand < new_ops.inf)
+            & (cand <= d)
+        )
+    for b in range(new_ops.n_bands):
+        cand = new_ops.band0_cand(d, b)
+        fire = fire | (
+            improved_band[b][:, None] & (cand < new_ops.inf) & (cand <= d)
+        )
+    col_mask = (
+        jnp.any(aff, axis=0)
+        | jnp.any(bit_off, axis=0)
+        | jnp.any(fire, axis=0)
+    )
+    return aff, col_mask, done
+
+
+@functools.partial(
+    jax.jit,
+    donate_argnums=(0, 1),
+    static_argnames=(
+        "check_every",
+        "max_blocks",
+        "depth",
+        "resid_rounds",
+        "small_dist",
+        "chord_mode",
+        "n_words",
+    ),
+)
+def delta_relax(
+    dist: jax.Array,  # [N*, P] — DONATED previous product
+    bitmap: jax.Array,  # [N, P, W] uint32 — DONATED previous bitmap
+    aff: jax.Array,  # [N, P] bool — delta_frontier's affected entries
+    col_idx: jax.Array,  # [Pb] int32 — affected columns, padded with
+    #   col_idx[0] repeats (pad lanes compute real duplicate results, so
+    #   the convergence verdict stays meaningful)
+    dest_ids: jax.Array,  # [P] int32 — the product's destination ids
+    bg,  # NEW topology's banded decomposition
+    r_edge_up: jax.Array,  # NEW reverse runtime arrays
+    r_edge_metric: jax.Array,
+    node_overloaded: jax.Array,
+    resid_slot: jax.Array,  # NEW EpilogueMaps
+    band_slot: jax.Array,
+    check_every: int = 4,
+    max_blocks: int = 64,
+    depth: int = 3,
+    resid_rounds: int = 1,
+    small_dist: bool = False,
+    chord_mode: bool = False,
+    n_words: int = 1,
+):
+    """Re-relax ONLY the affected destination columns and write them
+    back into the donated full-width product.
+
+    Per affected column the init is the old distances with the affected
+    entries re-set to INF and the destination re-pinned to 0 — the
+    worsening-direction upper bound (`_affected_init` safety argument:
+    every kept entry has a surviving old shortest path; improvements in
+    the same batch only loosen the bound).  The progressive while_loop
+    then runs to the on-device fixed point and the fused verify+bitmap
+    epilogue (the `_fused_progressive_banded` discipline: each [N, Pb]
+    candidate is read once for both the convergence verdict and the
+    ECMP bit) certifies exactness and re-encodes the columns' bitmaps
+    under the NEW slot maps.
+
+    Returns (dist' [N*, P], bitmap' [N, P, W], converged, blocks).
+    Donation holds because both outputs keep the donated avals — the
+    write-back is a hit-matrix select, never a scatter.  `converged`
+    False (block budget ran out, or the uint16 saturation guard
+    tripped) means the outputs are NOT a certified product and the
+    caller must cold-rebuild — the donated inputs are gone either way.
+    """
+    from .banded import _RelaxOps, make_dist0_orig
+
+    n = bg.n_nodes
+    inf = jnp.uint16(INF16) if small_dist else jnp.int32(INF32)
+    d_cols = jnp.take(dist[:n], col_idx, axis=1)  # [N, Pb]
+    aff_cols = jnp.take(aff, col_idx, axis=1)
+    init = jnp.where(aff_cols, inf, d_cols)
+    sub_dest = jnp.take(dest_ids, col_idx)  # [Pb]
+    d0 = jnp.minimum(
+        make_dist0_orig(sub_dest, n, small_dist=small_dist), init
+    )
+    ops = _RelaxOps(
+        bg,
+        r_edge_up,
+        r_edge_metric,
+        node_overloaded[:n],
+        0 if chord_mode else depth,
+        resid_rounds,
+        None,
+        small_dist,
+        chord_mode,
+        d0.dtype,
+    )
+
+    def body(state):
+        d, _, i = state
+        for _ in range(check_every - 1):
+            d = ops.supersweep(d)
+        v = ops.supersweep(d)
+        return v, jnp.all(v == d), i + jnp.int32(1)
+
+    def cond(state):
+        _, conv, i = state
+        return jnp.logical_and(~conv, i < max_blocks)
+
+    d, _, blocks = jax.lax.while_loop(
+        cond, body, (d0, jnp.bool_(False), jnp.int32(0))
+    )
+
+    # fused verify+bitmap epilogue over the column slab (authoritative
+    # exact check; see ops.allsources._fused_progressive_banded)
+    pb = d.shape[1]
+    fin = d < ops.inf
+    v = d
+
+    def bit_of(slot_row):
+        return jnp.where(
+            slot_row >= 0,
+            jnp.uint32(1)
+            << (jnp.maximum(slot_row, 0) % 32).astype(jnp.uint32),
+            jnp.uint32(0),
+        )
+
+    groups = [
+        (functools.partial(ops.resid_cand, d, k), resid_slot[:, k])
+        for k in range(ops.n_resid)
+    ] + [
+        (functools.partial(ops.band0_cand, d, b), band_slot[b])
+        for b in range(ops.n_bands)
+    ]
+    if n_words == 1:
+        cb2d = jnp.zeros((n, pb), dtype=jnp.uint32)
+        for mk_cand, srow in groups:
+            cand = mk_cand()
+            on = fin & (cand == d)
+            cb2d = cb2d | jnp.where(on, bit_of(srow)[:, None], jnp.uint32(0))
+            v = jnp.minimum(v, cand)
+        col_bitmap = cb2d[:, :, None]
+    else:
+        col_bitmap = jnp.zeros((n, pb, n_words), dtype=jnp.uint32)
+        for mk_cand, srow in groups:
+            cand = mk_cand()
+            on = fin & (cand == d)
+            word_sel = (jnp.maximum(srow, 0) // 32)[:, None] == jnp.arange(
+                n_words
+            )[None, :]
+            col_bitmap = col_bitmap | jnp.where(
+                on[:, :, None] & word_sel[:, None, :],
+                bit_of(srow)[:, None, None],
+                jnp.uint32(0),
+            )
+            v = jnp.minimum(v, cand)
+    converged = jnp.all(v == d)
+    if small_dist:
+        converged = u16_saturation_verdict(d, converged)
+
+    # scatter-free column write-back: for full-width column p, `sel`
+    # picks the slab lane that computed it (duplicate pad lanes carry
+    # identical results, so max-of-matches is safe), `have` gates the
+    # overwrite
+    p = dist.shape[1]
+    hit = col_idx[None, :] == jnp.arange(p, dtype=jnp.int32)[:, None]
+    have = hit.any(axis=1)  # [P]
+    sel = jnp.where(
+        hit, jnp.arange(pb, dtype=jnp.int32)[None, :], 0
+    ).max(axis=1)  # [P]
+    new_cols = jnp.take(d, sel, axis=1)  # [N, P]
+    new_dist = jnp.where(have[None, :], new_cols, dist[:n])
+    # re-attach the pad rows (empty when N* == n; XLA elides the concat)
+    new_dist = jnp.concatenate([new_dist, dist[n:]], axis=0)
+    new_bm = jnp.where(
+        have[None, :, None], jnp.take(col_bitmap, sel, axis=1), bitmap
+    )
+    return new_dist, new_bm, converged, blocks
+
+
+@functools.partial(
+    jax.jit, donate_argnums=(0,), static_argnames=("n_words",)
+)
+def delta_rows_bitmap(
+    bitmap: jax.Array,  # [N, P, W] uint32 — DONATED current bitmap
+    dist: jax.Array,  # [N*, P] — CURRENT exact reverse product
+    row_idx: jax.Array,  # [Rb] int32 — rows whose out-slot map changed,
+    #   padded with row_idx[0] repeats
+    out_nbr: jax.Array,  # NEW OutEll tables
+    out_eid: jax.Array,
+    out_slot: jax.Array,
+    f_edge_metric: jax.Array,  # FORWARD runtime arrays (OutEll.eid's)
+    f_edge_up: jax.Array,
+    node_overloaded: jax.Array,
+    n_words: int = 1,
+):
+    """Re-encode the ECMP bitmap rows whose slot layout changed.
+
+    The distances are already exact for every column; only the bit
+    POSITIONS moved (a node gaining/losing an out-neighbor re-ranks its
+    sorted unique out-neighbors).  Recompute the LFA-free condition
+    (`ecmp_bitmap_from_reverse_dist`) for just the bucketed row set
+    across all P columns and write the rows back through the donated
+    bitmap with a hit-matrix select.  Work: O(Rb * K * P).
+    """
+    n = bitmap.shape[0]
+    u16 = dist.dtype == jnp.uint16
+    inf = INF16 if u16 else INF32
+    rb = row_idx.shape[0]
+    k_pad = out_nbr.shape[1]
+    nbr_r = jnp.take(out_nbr, row_idx, axis=0)  # [Rb, K]
+    eid_r = jnp.take(out_eid, row_idx, axis=0)
+    slot_r = jnp.take(out_slot, row_idx, axis=0)
+    d_self = jnp.take(dist[:n], row_idx, axis=0)  # [Rb, P]
+    p_dim = d_self.shape[1]
+
+    def slot_on(k):
+        eidk = eid_r[:, k]
+        ok = (eidk >= 0) & jnp.take(f_edge_up, jnp.maximum(eidk, 0))
+        w = jnp.take(f_edge_metric, jnp.maximum(eidk, 0))  # [Rb]
+        if u16:
+            w = clamp_metric_u16(w)
+        nbr = nbr_r[:, k]
+        d_nbr = jnp.take(dist[:n], nbr, axis=0)  # [Rb, P]
+        nbr_ov = jnp.take(node_overloaded, nbr)  # [Rb]
+        return (
+            ok[:, None]
+            & (d_nbr < inf)
+            & (d_nbr + w[:, None] == d_self)
+            & (~nbr_ov[:, None] | (d_nbr == 0))
+        )
+
+    rows_bm = jnp.zeros((rb, p_dim, n_words), dtype=jnp.uint32)
+    for k in range(k_pad):
+        on = slot_on(k)
+        slot = slot_r[:, k]
+        bit = jnp.where(
+            slot >= 0,
+            jnp.uint32(1) << (jnp.maximum(slot, 0) % 32).astype(jnp.uint32),
+            jnp.uint32(0),
+        )
+        word_sel = (jnp.maximum(slot, 0) // 32)[:, None] == jnp.arange(
+            n_words
+        )[None, :]
+        rows_bm = rows_bm | jnp.where(
+            on[:, :, None] & word_sel[:, None, :],
+            bit[:, None, None],
+            jnp.uint32(0),
+        )
+
+    hit = row_idx[None, :] == jnp.arange(n, dtype=jnp.int32)[:, None]
+    have = hit.any(axis=1)  # [N]
+    sel = jnp.where(
+        hit, jnp.arange(rb, dtype=jnp.int32)[None, :], 0
+    ).max(axis=1)  # [N]
+    return jnp.where(
+        have[:, None, None], jnp.take(rows_bm, sel, axis=0), bitmap
+    )

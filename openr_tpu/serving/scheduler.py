@@ -53,7 +53,14 @@ SERVING_COUNTER_KEYS = (
     "serving.batch_occupancy",
     "serving.p50_us",
     "serving.p99_us",
+    "serving.deferrals",
 )
+
+# batch-formation hold while topology events are pending (defer_hint):
+# per-wait sleep quantum and the bounded total hold per round — queries
+# are never deferred past this, storm or not
+_DEFER_TICK_S = 0.002
+_DEFER_MAX_S = 0.05
 
 # bounded retry against a topology that moves between coalescing and
 # dispatch; each retry re-reads the epoch and recomputes fresh
@@ -125,9 +132,17 @@ class QueryScheduler(OpenrEventBase):
         backend,
         max_pending: int = 1024,
         max_coalesce: int = 64,
+        defer_hint: Optional[Callable[[], int]] = None,
     ) -> None:
         super().__init__(name="serving")
         self.backend = backend
+        # event-batching composition with the decision delta rung: a
+        # non-zero hint (Decision.pending_event_hint — topology events
+        # admitted but not yet folded into routes) holds batch formation
+        # for a BOUNDED beat so the batch pins the post-storm epoch and
+        # rides the delta-updated product instead of racing an epoch
+        # about to be invalidated.  None keeps the legacy behavior.
+        self.defer_hint = defer_hint
         # route the backend's counter bumps (serving.host_fallbacks) into
         # this scheduler's serving.* registry
         if hasattr(backend, "_bump"):
@@ -268,6 +283,23 @@ class QueryScheduler(OpenrEventBase):
                     if nxt is None:
                         break
                     drained.append(nxt)
+                # defer-on-pending-events: hold the round (bounded) while
+                # the decision layer still has unfolded topology events,
+                # so the epoch pinned below is the post-coalesce one —
+                # without this a storm turns into pin/dispatch/invalidate
+                # churn through the epoch-retry loop instead of one clean
+                # batch against the delta-updated product
+                if self.defer_hint is not None:
+                    deadline = time.perf_counter() + _DEFER_MAX_S
+                    deferred = False
+                    while (
+                        self.defer_hint() > 0
+                        and time.perf_counter() < deadline
+                    ):
+                        deferred = True
+                        await asyncio.sleep(_DEFER_TICK_S)
+                    if deferred:
+                        self._bump("serving.deferrals")
                 # one epoch read per area per round: every query grouped
                 # here pins the SAME topology version
                 epochs: dict[str, int] = {}

@@ -515,3 +515,57 @@ class TestCounterRegistrySweep:
             shim.stop()
             shim.wait_until_stopped(5)
         assert set(SERVING_COUNTER_KEYS) <= set(shimmed)
+
+    def test_delta_family_on_both_wire_surfaces(self, daemon):
+        """The incremental-delta families (decision.delta.* from the
+        coalescer pre-seed, device.engine.delta_* from the engine rung)
+        answer ONE getCounters on the native ctrl server AND the fb303
+        shim from daemon start — before any delta update has run — so
+        dashboards can alert on fallbacks/full_restages going non-zero
+        without waiting for the first storm."""
+        import re
+
+        from openr_tpu.decision.delta import DELTA_COUNTER_KEYS
+        from openr_tpu.device import ENGINE_COUNTER_KEYS
+        from openr_tpu.interop import thrift_binary as tb
+        from openr_tpu.interop.shim import ThriftBinaryShim
+        from test_thrift_binary import _call_ok
+
+        engine_delta = [
+            k for k in ENGINE_COUNTER_KEYS
+            if k.startswith("device.engine.delta_")
+        ]
+        assert engine_delta, "engine registry lost its delta_* family"
+
+        client = CtrlClient(port=daemon.ctrl_port)
+        try:
+            native = client.call("getCounters")
+        finally:
+            client.close()
+        assert set(DELTA_COUNTER_KEYS) <= set(native)
+        assert set(engine_delta) <= set(native)
+
+        name_re = re.compile(r"[a-z][a-z0-9_]*(\.[a-z0-9_]+)+\Z")
+        assert all(name_re.match(k) for k in DELTA_COUNTER_KEYS)
+
+        shim = ThriftBinaryShim(
+            daemon.kvstore,
+            port=0,
+            node_name="solo",
+            counters_fn=daemon.ctrl_server.handler._all_counters,
+        )
+        shim.run()
+        try:
+            shimmed = _call_ok(
+                shim.port,
+                "getCounters",
+                43,
+                b"\x00",
+                ("map", tb.T_STRING, tb.T_I64),
+                dec=lambda m: {k.decode(): v for k, v in m.items()},
+            )
+        finally:
+            shim.stop()
+            shim.wait_until_stopped(5)
+        assert set(DELTA_COUNTER_KEYS) <= set(shimmed)
+        assert set(engine_delta) <= set(shimmed)

@@ -1599,3 +1599,71 @@ class TestLongPublicationSequenceEngineBacked:
         fresh = build_link_state(self.ring6(m12=15))
         db_fresh = routes("1", {"0": fresh}, ps)
         assert db_fresh.unicast_routes == check().unicast_routes
+
+
+class TestDeltaPathEventParity:
+    """DecisionTest-tranche slice for the incremental delta rung: a
+    persistent solver with fleet_delta=True and one with the legacy full
+    path consume the same interleaved adjacency + metric + overload
+    event stream, and every intermediate fleet RIB must be identical —
+    the delta product is a pure perf substitution, never a route change."""
+
+    NODES = [
+        "r000", "r001", "r004", "r016", "r031", "r032", "r047", "r063"
+    ]
+
+    def test_interleaved_events_identical_ribs(self):
+        from tests.test_delta import _ps, ring_ls, set_node
+
+        ls = ring_ls()
+        ps = _ps()
+        area_ls = {"0": ls}
+
+        def backend():
+            return DeviceSpfBackend(min_device_nodes=1, min_device_sources=1)
+
+        solver_delta = SpfSolver(
+            "r000", spf_backend=backend(), fleet_delta=True
+        )
+        solver_full = SpfSolver(
+            "r000", spf_backend=backend(), fleet_delta=False
+        )
+
+        def step(mutate=None):
+            if mutate is not None:
+                mutate()
+            dbs_d = solver_delta.fleet_route_dbs(area_ls, ps, nodes=self.NODES)
+            dbs_f = solver_full.fleet_route_dbs(area_ls, ps, nodes=self.NODES)
+            assert dbs_d.keys() == dbs_f.keys()
+            for node in dbs_d:
+                assert (
+                    dbs_d[node].unicast_routes == dbs_f[node].unicast_routes
+                ), node
+                assert (
+                    dbs_d[node].mpls_routes == dbs_f[node].mpls_routes
+                ), node
+
+        step()  # cold baseline
+        # metric worsen + restore on the r000-r001 link
+        step(lambda: set_node(ls, 0, metric=lambda a, b: 90 if b == 1 else 20))
+        step(lambda: set_node(ls, 0))
+        # adjacency down + up (edge-set change: slot re-encode rung)
+        step(lambda: set_node(ls, 0, drop=1))
+        step(lambda: set_node(ls, 0))
+        # overload pulse on a transit node (dense frontier: the delta
+        # solver falls back to the legacy program — parity must hold
+        # through the fallback too)
+        step(lambda: set_node(ls, 5, is_overloaded=True))
+        step(lambda: set_node(ls, 5))
+        # coalesced batch: two metric events land between rebuilds
+        def batch():
+            set_node(ls, 4, metric=lambda a, b: 5 if b == 5 else 20)
+            set_node(ls, 2, metric=lambda a, b: 70 if b == 3 else 20)
+
+        step(batch)
+
+        # the delta rung really carried updates (not wall-to-wall fallback)
+        assert solver_delta.counters["decision.delta.updates"] >= 4
+        assert solver_delta.counters["decision.delta.events_coalesced"] >= 5
+        # and the legacy solver never touched it
+        assert solver_full.counters["decision.delta.updates"] == 0

@@ -1,0 +1,376 @@
+"""Incremental delta SPF rung (ops/delta.py, decision/delta.py, engine
+delta_dispatch): a coalesced batch of LinkState events folds into the
+previous device product at frontier-proportional cost, bit-exact against
+a fresh cold build in every change direction, with the legacy paths as
+the fallback on any gate failure."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from openr_tpu.decision.fleet import FleetViewCache, fleet_destinations
+from openr_tpu.decision.link_state import LinkState
+from openr_tpu.device.engine import (
+    DeviceResidencyEngine,
+    EpochMismatchError,
+)
+from openr_tpu.types import AdjacencyDatabase, PrefixEntry
+from tests.test_spf_solver import (
+    PFX,
+    adj,
+    build_link_state,
+    prefix_state_with,
+    square,
+)
+
+
+def ring_ls(n=64, metric=lambda a, b: 20) -> LinkState:
+    """64-node ring with +-1/+-2 links, every node labeled — the banded
+    warm-path fixture of tests/test_fleet.py (P == 64 >= delta_min_p, so
+    the delta rung engages)."""
+    def name(i):
+        return f"r{i % n:03d}"
+
+    adj_map = {}
+    labels = {}
+    for i in range(n):
+        me = name(i)
+        adj_map[me] = [
+            adj(me, name(i + d), metric=metric(i, (i + d) % n))
+            for d in (1, -1, 2, -2)
+        ]
+        labels[me] = 1000 + i
+    return build_link_state(adj_map, labels=labels)
+
+
+def set_node(ls, i, metric=lambda a, b: 20, drop=None, is_overloaded=False):
+    def name(j):
+        return f"r{j % 64:03d}"
+
+    ls.update_adjacency_database(
+        AdjacencyDatabase(
+            this_node_name=name(i),
+            adjacencies=[
+                adj(name(i), name(i + d), metric=metric(i, (i + d) % 64))
+                for d in (1, -1, 2, -2)
+                if d != drop
+            ],
+            is_overloaded=is_overloaded,
+            node_label=1000 + i,
+            area="0",
+        )
+    )
+
+
+def _ps():
+    return prefix_state_with(
+        ("r063", "0", PrefixEntry(prefix=PFX)),
+        ("r000", "0", PrefixEntry(prefix="::2:0/112")),
+    )
+
+
+class TestDeltaPath:
+    """Every change direction through FleetViewCache(delta=True): the
+    delta rung must label the rebuild warm_mode == "delta" and match a
+    fresh cold build bit-for-bit on distances AND bitmap."""
+
+    def _run(self, mutations, **cache_kw):
+        counters: dict[str, int] = {}
+
+        def bump(name, delta=1):
+            counters[name] = counters.get(name, 0) + delta
+
+        views = []
+        for use_delta in (True, False):
+            ls = ring_ls()
+            ps = _ps()
+            dests = fleet_destinations(ls, ps)
+            engine = DeviceResidencyEngine()
+            cache = FleetViewCache(
+                delta=use_delta, bump=bump if use_delta else None, **cache_kw
+            )
+            if use_delta:
+                v1 = cache.view(ls, dests, engine=engine)
+                assert not v1.warm
+            for m in mutations:
+                m(ls)
+            views.append(
+                (
+                    cache.view(ls, fleet_destinations(ls, ps), engine=engine),
+                    engine,
+                )
+            )
+        (delta_view, engine), (cold_view, _) = views
+        assert not cold_view.warm
+        np.testing.assert_array_equal(
+            np.asarray(delta_view._dist_dev), np.asarray(cold_view._dist_dev)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(delta_view._bitmap_dev),
+            np.asarray(cold_view._bitmap_dev),
+        )
+        return delta_view, engine, counters
+
+    def test_metric_increase_delta_bit_exact(self):
+        view, engine, counters = self._run(
+            [lambda ls: set_node(ls, 0, metric=lambda a, b: 90 if b == 1 else 20)]
+        )
+        assert view.warm_mode == "delta"
+        assert counters["decision.delta.updates"] == 1
+        assert counters["decision.delta.affected_cols"] > 0
+        assert engine.counters["device.engine.delta_dispatches"] >= 2
+
+    def test_metric_decrease_delta_bit_exact(self):
+        view, _, counters = self._run(
+            [lambda ls: set_node(ls, 0, metric=lambda a, b: 5 if b == 1 else 20)]
+        )
+        assert view.warm_mode == "delta"
+        assert counters["decision.delta.updates"] == 1
+
+    def test_link_down_delta_bit_exact(self):
+        # adjacency withdrawal changes the edge SET: exercises the
+        # worsened frontier AND the out-slot row re-encode kernel
+        view, _, counters = self._run([lambda ls: set_node(ls, 0, drop=1)])
+        assert view.warm_mode == "delta"
+        assert counters["decision.delta.updates"] == 1
+
+    def test_link_up_delta_bit_exact(self):
+        def down(ls):
+            set_node(ls, 0, drop=1)
+
+        def up(ls):
+            set_node(ls, 0)
+
+        # two cache rounds: down (delta), then back up (delta) — the
+        # second is the improvement direction over a changed edge set
+        counters: dict[str, int] = {}
+
+        def bump(name, delta=1):
+            counters[name] = counters.get(name, 0) + delta
+
+        ls = ring_ls()
+        ps = _ps()
+        dests = fleet_destinations(ls, ps)
+        engine = DeviceResidencyEngine()
+        cache = FleetViewCache(delta=True, bump=bump)
+        cache.view(ls, dests, engine=engine)
+        down(ls)
+        v2 = cache.view(ls, dests, engine=engine)
+        assert v2.warm_mode == "delta"
+        up(ls)
+        v3 = cache.view(ls, dests, engine=engine)
+        assert v3.warm_mode == "delta"
+        assert counters["decision.delta.updates"] == 2
+        # flap recovery restores the original product bit-for-bit
+        cold = FleetViewCache().view(ring_ls(), dests)
+        np.testing.assert_array_equal(
+            np.asarray(v3._dist_dev), np.asarray(cold._dist_dev)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(v3._bitmap_dev), np.asarray(cold._bitmap_dev)
+        )
+
+    def test_overload_dense_frontier_falls_back_bit_exact(self):
+        # draining a symmetric-ring transit node invalidates paths in
+        # more than half the columns: the bucket ladder refuses (the full
+        # fused product is cheaper) and the legacy worsen path serves —
+        # still bit-exact (asserted by _run)
+        view, engine, counters = self._run(
+            [lambda ls: set_node(ls, 5, is_overloaded=True)]
+        )
+        assert view.warm_mode == "worsen"
+        assert counters["decision.delta.fallbacks"] == 1
+        assert (
+            engine.counters["device.engine.delta_overflow_fallbacks"] == 1
+        )
+
+    def test_overload_of_non_transit_node_is_sparse_delta(self):
+        # node 5's links are expensive in both directions, so no tight
+        # chain transits it: draining it must flag (at most) its own
+        # column — the slot-level worsened mask conservatively marks the
+        # tight last-hop into the drained node — and relax just that
+        counters: dict[str, int] = {}
+
+        def bump(name, delta=1):
+            counters[name] = counters.get(name, 0) + delta
+
+        expensive = lambda a, b: 200 if 5 in (a, b) else 20  # noqa: E731
+        ls = ring_ls(metric=expensive)
+        dests = fleet_destinations(ls, _ps())
+        engine = DeviceResidencyEngine()
+        cache = FleetViewCache(delta=True, bump=bump)
+        cache.view(ls, dests, engine=engine)
+        set_node(ls, 5, metric=expensive, is_overloaded=True)
+        v2 = cache.view(ls, dests, engine=engine)
+        assert v2.warm_mode == "delta"
+        assert counters["decision.delta.updates"] == 1
+        assert counters["decision.delta.affected_cols"] <= 4
+        ls_cold = ring_ls(metric=expensive)
+        set_node(ls_cold, 5, metric=expensive, is_overloaded=True)
+        cold = FleetViewCache().view(ls_cold, dests)
+        np.testing.assert_array_equal(
+            np.asarray(v2._dist_dev), np.asarray(cold._dist_dev)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(v2._bitmap_dev), np.asarray(cold._bitmap_dev)
+        )
+
+    def test_worsening_dominated_link_is_certified_noop(self):
+        # the r000->r002 chord starts strictly dominated (100 vs 40 via
+        # r001), so worsening it further is tight NOWHERE: the frontier
+        # certifies empty and the previous product is adopted verbatim
+        counters: dict[str, int] = {}
+
+        def bump(name, delta=1):
+            counters[name] = counters.get(name, 0) + delta
+
+        dom = lambda w: (  # noqa: E731
+            lambda a, b: w if (a, b) == (0, 2) else 20
+        )
+        ls = ring_ls(metric=dom(100))
+        dests = fleet_destinations(ls, _ps())
+        engine = DeviceResidencyEngine()
+        cache = FleetViewCache(delta=True, bump=bump)
+        cache.view(ls, dests, engine=engine)
+        set_node(ls, 0, metric=dom(150))
+        v2 = cache.view(ls, dests, engine=engine)
+        assert v2.warm_mode == "delta"
+        assert counters["decision.delta.noop_updates"] == 1
+        assert "decision.delta.updates" not in counters
+        # the adopted product (inherited verbatim from the previous
+        # view) still matches a cold build of the mutated snapshot
+        ls_cold = ring_ls(metric=dom(100))
+        set_node(ls_cold, 0, metric=dom(150))
+        cold = FleetViewCache().view(ls_cold, dests)
+        np.testing.assert_array_equal(
+            np.asarray(v2._dist_dev), np.asarray(cold._dist_dev)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(v2._bitmap_dev), np.asarray(cold._bitmap_dev)
+        )
+        # only the frontier program ran: no relax, no row re-encode
+        assert engine.counters["device.engine.delta_dispatches"] == 1
+
+    def test_mixed_event_batch_coalesces_to_one_update(self):
+        # k pending metric events (two worsens + an improve on nearby
+        # nodes) fold into ONE delta update whose events_coalesced
+        # counts them all and whose frontier is the union of the three
+        view, _, counters = self._run(
+            [
+                lambda ls: set_node(
+                    ls, 0, metric=lambda a, b: 90 if b == 1 else 20
+                ),
+                lambda ls: set_node(
+                    ls, 4, metric=lambda a, b: 5 if b == 5 else 20
+                ),
+                lambda ls: set_node(
+                    ls, 2, metric=lambda a, b: 70 if b == 3 else 20
+                ),
+            ]
+        )
+        assert view.warm_mode == "delta"
+        assert counters["decision.delta.updates"] == 1
+        assert counters["decision.delta.events_coalesced"] >= 3
+
+    def test_parity_gate_clean(self):
+        _, _, counters = self._run(
+            [lambda ls: set_node(ls, 0, drop=1)], delta_parity=True
+        )
+        assert counters["decision.delta.parity_checks"] == 1
+        assert counters.get("decision.delta.parity_failures", 0) == 0
+
+    def test_min_p_gate_falls_back_to_legacy(self):
+        view, engine, counters = self._run(
+            [lambda ls: set_node(ls, 0, drop=1)], delta_min_p=1000
+        )
+        assert view.warm_mode == "worsen"  # legacy path, still bit-exact
+        assert "decision.delta.updates" not in counters
+        assert engine.counters["device.engine.delta_dispatches"] == 0
+
+    def test_small_topology_stays_on_legacy_paths(self):
+        # no banded structure -> eligible() False, zero delta dispatches
+        ls = square()
+        ps = prefix_state_with(("4", "0", PrefixEntry(prefix=PFX)))
+        dests = fleet_destinations(ls, ps)
+        engine = DeviceResidencyEngine()
+        cache = FleetViewCache(delta=True)
+        cache.view(ls, dests, engine=engine)
+        set_node_sq = lambda: ls.update_adjacency_database(  # noqa: E731
+            AdjacencyDatabase(
+                this_node_name="1",
+                adjacencies=[adj("1", "2", metric=30), adj("1", "3")],
+                node_label=101,
+                area="0",
+            )
+        )
+        set_node_sq()
+        v2 = cache.view(ls, dests, engine=engine)
+        assert v2.warm_mode != "delta"
+        assert engine.counters["device.engine.delta_dispatches"] == 0
+
+    def test_no_engine_stays_on_legacy_paths(self):
+        ls = ring_ls()
+        dests = fleet_destinations(ls, _ps())
+        cache = FleetViewCache(delta=True)
+        cache.view(ls, dests)
+        set_node(ls, 0, drop=1)
+        v2 = cache.view(ls, dests)
+        assert v2.warm_mode == "worsen"
+
+
+class TestEngineDeltaRung:
+    def test_bucket_ladder(self):
+        engine = DeviceResidencyEngine()
+        assert engine.delta_bucket(5, 1024) == 8
+        assert engine.delta_bucket(9, 1024) == 16
+        assert engine.delta_bucket(129, 1024) == 256
+        assert (
+            engine.counters["device.engine.delta_overflow_fallbacks"] == 0
+        )
+
+    def test_bucket_overflow(self):
+        engine = DeviceResidencyEngine()
+        # more than half the product: the full program is cheaper
+        assert engine.delta_bucket(600, 1024) is None
+        # bucket would cover the whole product
+        assert engine.delta_bucket(40, 64) is None
+        # above the ladder entirely
+        assert engine.delta_bucket(600, 4096) is None
+        assert (
+            engine.counters["device.engine.delta_overflow_fallbacks"] == 3
+        )
+
+    def test_epoch_refusal(self):
+        from types import SimpleNamespace
+
+        engine = DeviceResidencyEngine()
+        csr = SimpleNamespace(version=7)
+        with pytest.raises(EpochMismatchError):
+            engine.delta_dispatch(
+                "relax", lambda: None, csr=csr, expect_epoch=6
+            )
+        assert engine.counters["device.engine.epoch_invalidations"] == 1
+        assert engine.counters["device.engine.delta_dispatches"] == 0
+
+    def test_dispatch_and_bucket_accounting(self):
+        engine = DeviceResidencyEngine()
+        key = ("relax", (64, 256, 64), 16, 1, True, 0, True)
+        engine.delta_dispatch("relax", lambda: 1, bucket_key=key)
+        engine.delta_dispatch("relax", lambda: 1, bucket_key=key)
+        assert engine.counters["device.engine.delta_dispatches"] == 2
+        assert engine.counters["device.engine.delta_bucket_misses"] == 1
+        assert engine.counters["device.engine.delta_bucket_hits"] == 1
+
+    def test_register_accounts_the_initial_upload(self):
+        engine = DeviceResidencyEngine()
+        engine.delta_register(4096)
+        assert engine.counters["device.engine.full_restages"] == 1
+        assert engine.counters["device.engine.bytes_staged"] == 4096
+
+    def test_fault_hook_sees_delta_ops(self):
+        seen = []
+        engine = DeviceResidencyEngine()
+        engine.fault_hook = seen.append
+        engine.delta_dispatch("frontier", lambda: None)
+        assert seen == ["delta_frontier"]

@@ -1,0 +1,60 @@
+"""Flap-storm chaos scenario: the delta rung under a 1k-event storm.
+
+The storm is the end-to-end proof of the incremental delta dataflow:
+a seeded, replayable 1k-event flap sequence is coalesced into one
+engine dispatch chain per chunk, every chunk must land through the
+delta programs (no frontier-overflow fallbacks), the engine must never
+restage the full product after the initial upload, and the post-storm
+product must be bit-exact against a cold host-oracle rebuild.
+"""
+
+import pytest
+
+from openr_tpu.chaos import ChaosEventLog, FlapStormScenario
+
+jax = pytest.importorskip("jax")
+
+
+@pytest.fixture(scope="module")
+def storm():
+    log = ChaosEventLog()
+    result = FlapStormScenario(seed=7, log_=log).run()
+    return result, log
+
+
+class TestFlapStormScenario:
+    def test_every_chunk_lands_through_the_delta_path(self, storm):
+        result, _ = storm
+        assert result.chunk_modes == ["delta"] * result.chunks
+        assert result.delta_updates == result.chunks
+        assert result.delta_fallbacks == 0
+
+    def test_post_storm_product_is_bit_exact_vs_host_oracle(self, storm):
+        result, _ = storm
+        assert result.bit_exact
+
+    def test_initial_upload_is_the_only_full_restage(self, storm):
+        result, _ = storm
+        assert result.full_restages == 1
+        # every chunk costs at most a frontier + relax + rows chain
+        assert result.delta_dispatches >= 2 * result.chunks
+        assert result.delta_dispatches <= 3 * result.chunks
+
+    def test_storm_coalesces_events_into_chunk_dispatches(self, storm):
+        result, _ = storm
+        assert result.events == 1000
+        assert result.counters["decision.delta.events_coalesced"] > 0
+        # 250 events per chunk collapse into one delta rebuild each
+        assert result.delta_updates + result.delta_noops == result.chunks
+
+    def test_same_seed_replays_bit_for_bit(self, storm):
+        _, log = storm
+        relog = ChaosEventLog()
+        FlapStormScenario(seed=7, log_=relog).run()
+        assert log.matches(relog)
+
+    def test_different_seed_diverges(self, storm):
+        _, log = storm
+        other = ChaosEventLog()
+        FlapStormScenario(seed=8, log_=other).run()
+        assert not log.matches(other)
