@@ -373,6 +373,28 @@ def _drive_delta(state: dict) -> None:
     assert v3 is not None and v3.converged and v3.warm_mode == "delta"
 
 
+def _drive_blocked(state: dict) -> None:
+    """Node-axis sharding rung (parallel.blocked): force the blocked
+    APSP through the fleet dispatch so all three phase kernels, the
+    destination-column extract and the bitmap root record specs.  The
+    threshold is dropped instead of env-forcing OPENR_NODE_SHARD so the
+    audit run does not leak environment into other drivers; the asserts
+    keep the driver honest — a silent fallback to the fused product
+    would leave the blocked roots spec-less and fail the audit later
+    with a much less actionable finding."""
+    from ..decision.fleet import FleetViewCache
+    from ..device.engine import DeviceResidencyEngine
+
+    ls = _ring_link_state()
+    engine = DeviceResidencyEngine()
+    engine.blocked.node_shard_threshold = 0  # every N engages the rung
+    cache = FleetViewCache()
+    view = cache.view(ls, ["r000", "r031", "r063"], engine=engine)
+    assert view is not None and view.converged and view.node_sharded
+    assert engine.blocked.counters["mesh.blocked.products"] == 1
+    assert engine.blocked.counters["mesh.blocked.fallbacks"] == 0
+
+
 def _drive_fleet_grid_ell(state: dict) -> None:
     """Fleet product on a grid: no banded structure, so the ELL fallback
     and its fixed-sweep kernels run."""
@@ -531,6 +553,7 @@ DRIVERS: tuple[tuple[str, Callable[[dict], None]], ...] = (
     ("engine", _drive_engine),
     ("fleet_ring", _drive_fleet_ring),
     ("delta", _drive_delta),
+    ("blocked", _drive_blocked),
     ("fleet_grid_ell", _drive_fleet_grid_ell),
     ("allsources_legacy", _drive_allsources_legacy),
     ("ksp", _drive_ksp),

@@ -52,6 +52,7 @@ class OpenrCtrlHandler:
         netlink=None,
         device=None,
         serving=None,
+        mesh=None,
         config=None,
         kvstore_updates_queue: Optional[ReplicateQueue[Publication]] = None,
         fib_updates_queue: Optional[ReplicateQueue] = None,
@@ -77,6 +78,9 @@ class OpenrCtrlHandler:
         # query scheduler (openr_tpu.serving.QueryScheduler): async query
         # methods below submit into its admission queue; exports serving.*
         self.serving = serving
+        # blocked-APSP node-sharding rung (openr_tpu.parallel.blocked
+        # .BlockedApspEngine): exports mesh.blocked.* the same way
+        self.mesh = mesh
         self.config = config
         self.kvstore_updates_queue = kvstore_updates_queue
         self.fib_updates_queue = fib_updates_queue
@@ -357,6 +361,7 @@ class OpenrCtrlHandler:
             self.netlink,
             self.device,
             self.serving,
+            self.mesh,
         ):
             if module is None:
                 continue

@@ -279,6 +279,11 @@ class FleetRouteView:
         # None | "improve" | "worsen" — which warm gate admitted the seed
         self.warm_mode: Optional[str] = None
         self.sweep_hint: Optional[int] = None
+        # True when the blocked node-sharded rung served this view: its
+        # [N, P] int32 product is NOT a valid warm/delta seed for the
+        # banded relax (dtype/shape contract differs), so the cache
+        # skips seeding from it
+        self.node_sharded = False
         self._runner = None  # retained for the NEXT view's worsening
         #   warm start: affected-set propagation runs over THIS view's
         #   reverse graph and distances (_affected_init)
@@ -321,7 +326,6 @@ class FleetRouteView:
         dest_ids = np.asarray(
             [self._node_id[d] for d in self.dest_names], dtype=np.int32
         )
-        runner = _reverse_runner(self.csr, hint=hint_seed)
         self._out = asrc.build_out_ell(
             self.csr.edge_src,
             self.csr.edge_dst,
@@ -329,6 +333,44 @@ class FleetRouteView:
             self.csr.n_nodes,
             out_slot=self.csr.out_slot,
         )
+        # third rung: node-axis sharded blocked APSP (parallel.blocked)
+        # when N outgrows the single-chip [N, P] ceiling (or the env
+        # forces it).  Any failure — mesh-shape mismatch, tile/device
+        # mismatch, an injected chaos fault mid-run — falls through to
+        # the dest-sharded fused product below, which is the bit-exact
+        # fallback.
+        blocked = (
+            getattr(self._engine, "blocked", None)
+            if self._engine is not None
+            else None
+        )
+        if blocked is not None and blocked.should_engage(self.csr.n_nodes):
+            try:
+                dist, bitmap, ok = blocked.fleet_product(
+                    self.csr, dest_ids, self._out
+                )
+            except Exception:
+                blocked._bump("mesh.blocked.fallbacks")
+                log.warning(
+                    "fleet: blocked-APSP rung failed; falling back to "
+                    "the dest-sharded fused product",
+                    exc_info=True,
+                )
+            else:
+                # `ok` is host-side by the rung's contract (the closure
+                # is exact after T rounds; no convergence certificate
+                # to fetch)
+                assert ok
+                self._dist_dev = dist
+                self._bitmap_dev = bitmap
+                self.converged = True
+                self.warm = False
+                self.warm_mode = None
+                self.sweep_hint = None
+                self._runner = None
+                self.node_sharded = True
+                return
+        runner = _reverse_runner(self.csr, hint=hint_seed)
         init = None
         self.warm_mode = None
         if runner.bg is not None:
@@ -578,6 +620,7 @@ class FleetViewCache:
         if (
             self._delta is not None
             and engine is not None
+            and (prev is None or not prev.node_sharded)
             and self._delta.eligible(prev)
             and self._delta.update(prev, view, engine)
         ):
@@ -589,6 +632,7 @@ class FleetViewCache:
         if (
             prev is not None
             and prev.converged
+            and not prev.node_sharded
             and prev._dist_dev is not None
             and prev.dest_names == view.dest_names
             and prev._node_id == view._node_id

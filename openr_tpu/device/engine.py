@@ -254,6 +254,15 @@ class DeviceResidencyEngine:
         self._delta_buckets_seen: set = set()
         # chaos seam: called with an op name at every engine entry point
         self.fault_hook: Optional[Callable[[str], None]] = None
+        # third dispatch rung (delta < fused full < blocked): node-axis
+        # sharded blocked APSP (parallel.blocked).  Eagerly constructed
+        # so its pre-seeded mesh.blocked.* counters dump before the
+        # first dispatch; the device mesh itself stays lazy.  It reads
+        # THIS engine's fault_hook, so chaos faults armed here fire
+        # inside the blocked rounds too.
+        from ..parallel.blocked import BlockedApspEngine
+
+        self.blocked = BlockedApspEngine(parent=self)
         # per-query attribution (read by bench rows)
         self.last_query_bytes = 0
         self.last_query_us = 0

@@ -350,6 +350,14 @@ class OpenrDaemon:
             # device-residency engine counters (device.engine.*) ride the
             # same getCounters surface as every module's
             device=getattr(self.decision.spf_solver.spf, "engine", None),
+            # node-sharding rung counters (mesh.blocked.*) ride along;
+            # pre-seeded at engine construction so they dump before the
+            # first blocked dispatch
+            mesh=getattr(
+                getattr(self.decision.spf_solver.spf, "engine", None),
+                "blocked",
+                None,
+            ),
             serving=self.serving,
             kvstore_updates_queue=self.kvstore_updates_queue,
             fib_updates_queue=self.fib_updates_queue,

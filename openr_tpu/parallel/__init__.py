@@ -16,10 +16,17 @@ This module is transport-free: it only places arrays.  Host-to-host state
 replication (the KvStore mesh) is a separate subsystem.
 """
 
+from .blocked import BlockedApspEngine, make_blocked_mesh
 from .mesh import (
     make_mesh,
     sharded_spf_forward,
     spf_step_sharded,
 )
 
-__all__ = ["make_mesh", "sharded_spf_forward", "spf_step_sharded"]
+__all__ = [
+    "BlockedApspEngine",
+    "make_blocked_mesh",
+    "make_mesh",
+    "sharded_spf_forward",
+    "spf_step_sharded",
+]

@@ -40,7 +40,12 @@ def make_mesh(devices=None, batch_axis: int | None = None) -> Mesh:
     n = len(devices)
     if batch_axis is None:
         batch_axis = n
-    assert n % batch_axis == 0, (n, batch_axis)
+    if batch_axis <= 0 or n % batch_axis:
+        raise ValueError(
+            f"make_mesh: {n} devices do not divide into a batch axis of "
+            f"{batch_axis} (the node axis would get {n}/{batch_axis} "
+            f"devices; pick a batch_axis that divides {n})"
+        )
     dev_array = np.asarray(devices).reshape(batch_axis, n // batch_axis)
     return Mesh(dev_array, ("batch", "node"))
 

@@ -422,6 +422,9 @@ class TestCounterRegistrySweep:
                 # and its admission RWQueue rides the daemon queue fabric
                 "serving.admitted",
                 "queue.serving_admission.overflows",
+                # the blocked node-sharding rung pre-seeds mesh.blocked.*
+                # in the engine's sub-registry before any product runs
+                "mesh.blocked.products",
             ):
                 assert key in counters, f"{key} missing from getCounters"
 
@@ -515,6 +518,50 @@ class TestCounterRegistrySweep:
             shim.stop()
             shim.wait_until_stopped(5)
         assert set(SERVING_COUNTER_KEYS) <= set(shimmed)
+
+    def test_mesh_blocked_family_on_both_wire_surfaces(self, daemon):
+        """The full mesh.blocked.* registry (blocked node-sharded APSP
+        rung: products, rounds, panel broadcasts, bytes, phase timers,
+        fallbacks) answers ONE getCounters on the native ctrl server AND
+        the fb303 shim, pre-seeded — dashboards see every key before the
+        first product dispatches."""
+        import re
+
+        from openr_tpu.interop import thrift_binary as tb
+        from openr_tpu.interop.shim import ThriftBinaryShim
+        from openr_tpu.parallel.blocked import BLOCKED_COUNTER_KEYS
+        from test_thrift_binary import _call_ok
+
+        client = CtrlClient(port=daemon.ctrl_port)
+        try:
+            native = client.call("getCounters")
+        finally:
+            client.close()
+        assert set(BLOCKED_COUNTER_KEYS) <= set(native)
+
+        shim = ThriftBinaryShim(
+            daemon.kvstore,
+            port=0,
+            node_name="solo",
+            counters_fn=daemon.ctrl_server.handler._all_counters,
+        )
+        shim.run()
+        try:
+            shimmed = _call_ok(
+                shim.port,
+                "getCounters",
+                41,
+                b"\x00",
+                ("map", tb.T_STRING, tb.T_I64),
+                dec=lambda m: {k.decode(): v for k, v in m.items()},
+            )
+        finally:
+            shim.stop()
+            shim.wait_until_stopped(5)
+        assert set(BLOCKED_COUNTER_KEYS) <= set(shimmed)
+
+        name_re = re.compile(r"[a-z][a-z0-9_]*(\.[a-z0-9_]+)+\Z")
+        assert all(name_re.match(k) for k in BLOCKED_COUNTER_KEYS)
 
     def test_delta_family_on_both_wire_surfaces(self, daemon):
         """The incremental-delta families (decision.delta.* from the
