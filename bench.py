@@ -1908,6 +1908,87 @@ def bench_serving_load_wan100k(
     }
 
 
+def bench_te_wan100k(
+    topo,
+    n_sources: int = 512,
+    n_dests: int = 4,
+    steps: int = 12,
+    round_trips: int = 3,
+) -> dict:
+    """Differentiable TE at wan100k: time-to-optimized-metrics for the
+    gradient-descent optimizer (soft float32 descent + exact uint32
+    validation gate, openr_tpu/te) on a seeded demand matrix, against a
+    host hill-climb baseline given the SAME number of exact-solver
+    evaluations.  Headline: optimizer wall seconds, exact objective
+    before/after for both searches, and descent steps taken.  Honors
+    OPENR_BENCH_BUDGET_S through the optimizer's budget hook (stages
+    shed, never a mid-stage kill)."""
+    from openr_tpu.te import TeOptimizer, TeProblem, hill_climb
+
+    rng = np.random.RandomState(0)
+    n = topo.n_nodes
+    dests = np.linspace(0, n - 1, n_dests).astype(np.int32)
+    sources = rng.choice(n, size=n_sources, replace=False)
+    demand = np.zeros((topo.node_capacity, n_dests), dtype=np.float32)
+    demand[sources] = rng.uniform(
+        0.5, 2.0, size=(n_sources, n_dests)
+    ).astype(np.float32)
+    demand[dests, np.arange(n_dests)] = 0.0
+    problem = TeProblem.from_topology(
+        topo, dests, demand, metric_lo=1, metric_hi=16
+    )
+
+    def room() -> float:
+        return _budget_left() - 120  # leave the harness its exit slack
+
+    opt = TeOptimizer()
+    t0 = time.perf_counter()
+    res = opt.optimize(
+        problem,
+        steps=steps,
+        round_trips=round_trips,
+        n_sweeps=64,
+        flow_sweeps=48,
+        budget_left=room,
+    )
+    te_wall_s = time.perf_counter() - t0
+
+    # host baseline: hill-climb spending the same exact-evaluation count
+    # the optimizer's validation gate spent (its only search oracle)
+    t0 = time.perf_counter()
+    _hm, hill_obj, hill_evals = hill_climb(
+        problem, rounds=res.round_trips, seed=1, budget_left=room
+    )
+    hill_wall_s = time.perf_counter() - t0
+
+    return {
+        "n_sources": n_sources,
+        "n_dests": n_dests,
+        "te_wall_s": round(te_wall_s, 2),
+        "te_steps": res.steps,
+        "te_round_trips": res.round_trips,
+        "te_accepted": res.accepted,
+        "exact_objective_before": round(res.objective_before, 4),
+        "exact_objective_after": round(res.objective_after, 4),
+        "te_improvement_frac": round(
+            1.0 - res.objective_after / res.objective_before, 4
+        )
+        if res.objective_before
+        else 0.0,
+        "hill_wall_s": round(hill_wall_s, 2),
+        "hill_evals": hill_evals,
+        "hill_objective_after": round(hill_obj, 4),
+        "te_beats_or_matches_hill": bool(
+            res.objective_after <= hill_obj + 1e-9
+        ),
+        "counters": {
+            k: v
+            for k, v in opt.get_counters().items()
+            if not k.endswith("_milli")
+        },
+    }
+
+
 class _Topos:
     """Lazy shared topology cache for the device-row child."""
 
@@ -1984,6 +2065,10 @@ DEVICE_ROWS = {
     # query-serving layer under open-loop load: sustained qps, p50/p99,
     # batch occupancy through admission/coalescing/double-buffering
     "serving_load_wan100k": lambda t: bench_serving_load_wan100k(t.wan),
+    # differentiable TE: gradient-descent metric optimization with the
+    # exact-solver acceptance gate vs host hill-climb at equal exact
+    # evaluations (openr_tpu/te; docs/OPERATIONS.md "TE runbook")
+    "te_wan100k": lambda t: bench_te_wan100k(t.wan),
 }
 
 DEVICE_NOTES = [

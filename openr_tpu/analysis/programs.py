@@ -549,6 +549,69 @@ def _drive_forward_direct(state: dict) -> None:
     )
 
 
+def _drive_te(state: dict) -> None:
+    """Differentiable-TE soft kernels (the tree's only float jit roots)
+    plus one exact-gate round trip: soft distances must anneal toward
+    the exact solver's, the descent step must move metrics, and the
+    rounded candidate must score through the uint32 product."""
+    import numpy as np
+
+    from ..te import TeOptimizer, TeProblem
+    from ..te import soft
+    from ..te.exact import INF32
+
+    # 8-node ring with one chord: small, asymmetric, cyclic
+    n = 8
+    links = np.array([[i, (i + 1) % n] for i in range(n)] + [[0, 4]])
+    mets = np.vstack([np.tile([1, 1], (n, 1)), [[2, 2]]])
+    from benchmarks.synthetic import Topology
+
+    topo = Topology.from_links("te_audit", n, links, mets)
+    dests = np.array([0, 3], dtype=np.int32)
+    demand = np.zeros((topo.node_capacity, 2), dtype=np.float32)
+    demand[1:n] = 1.0
+    demand[3, 1] = 0.0
+    problem = TeProblem.from_topology(topo, dests, demand, metric_hi=8)
+
+    import jax.numpy as jnp
+
+    args = (
+        jnp.asarray(problem.edge_src),
+        jnp.asarray(problem.edge_dst),
+        jnp.asarray(problem.edge_metric, dtype=jnp.float32),
+        jnp.asarray(problem.edge_up),
+        jnp.asarray(problem.node_overloaded),
+        jnp.asarray(problem.dest_ids),
+    )
+    # audit-harness direct dispatch, same rationale as _drive_forward_direct
+    dist = np.asarray(
+        # openr: disable=jit-unbucketed-dispatch
+        soft.soft_sssp(*args, np.float32(0.05), n_sweeps=8)
+    )
+    opt = TeOptimizer()
+    ev = opt._evaluator(problem)
+    exact = ev.distances(problem.edge_metric)
+    finite = exact[:n] < INF32
+    assert np.abs(dist[:n][finite] - exact[:n][finite]).max() < 0.5
+
+    # one descent step + exact gate through the optimizer front-end
+    # (traces soft_objective_value, te_descent_step, and the te_exact
+    # dispatch path)
+    res = opt.optimize(
+        problem, steps=2, round_trips=1, n_sweeps=8, flow_sweeps=8
+    )
+    assert res.metrics.dtype == np.int32
+    assert opt.get_counters()["te.steps"] == 2
+    # openr: disable=jit-unbucketed-dispatch
+    _ = soft.soft_objective_value(
+        jnp.asarray(problem.edge_metric, dtype=jnp.float32),
+        args[0], args[1], args[3], args[4], args[5],
+        jnp.asarray(problem.demand, dtype=jnp.float32),
+        jnp.asarray(problem.capacity, dtype=jnp.float32),
+        np.float32(0.1), np.float32(0.1), n_sweeps=8, flow_sweeps=8,
+    )
+
+
 DRIVERS: tuple[tuple[str, Callable[[dict], None]], ...] = (
     ("engine", _drive_engine),
     ("fleet_ring", _drive_fleet_ring),
@@ -559,6 +622,7 @@ DRIVERS: tuple[tuple[str, Callable[[dict], None]], ...] = (
     ("ksp", _drive_ksp),
     ("protection", _drive_protection),
     ("forward_direct", _drive_forward_direct),
+    ("te", _drive_te),
 )
 
 

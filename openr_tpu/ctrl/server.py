@@ -53,6 +53,7 @@ class OpenrCtrlHandler:
         device=None,
         serving=None,
         mesh=None,
+        te=None,
         config=None,
         kvstore_updates_queue: Optional[ReplicateQueue[Publication]] = None,
         fib_updates_queue: Optional[ReplicateQueue] = None,
@@ -81,6 +82,9 @@ class OpenrCtrlHandler:
         # blocked-APSP node-sharding rung (openr_tpu.parallel.blocked
         # .BlockedApspEngine): exports mesh.blocked.* the same way
         self.mesh = mesh
+        # differentiable-TE optimizer (openr_tpu.te.TeOptimizer): exports
+        # te.* counters (pre-seeded at construction) the same way
+        self.te = te
         self.config = config
         self.kvstore_updates_queue = kvstore_updates_queue
         self.fib_updates_queue = fib_updates_queue
@@ -213,6 +217,10 @@ class OpenrCtrlHandler:
         a["queryPaths"] = lambda p: self._serving_query("paths", p)
         a["queryWhatIf"] = lambda p: self._serving_query("what_if", p)
         a["queryKsp"] = lambda p: self._serving_query("ksp", p)
+        # differentiable TE: demand matrix + bounds in, exactly-validated
+        # proposed metrics + objective delta out; rides the scheduler's
+        # admission/epoch machinery (a flap mid-run aborts, never retries)
+        a["optimizeMetrics"] = self._optimize_metrics
 
         # -- fib --------------------------------------------------------------
         m["getRouteDbFib"] = self._fib_route_db
@@ -322,6 +330,30 @@ class OpenrCtrlHandler:
             "latencyUs": res.latency_us,
         }
 
+    async def _optimize_metrics(self, p: dict) -> dict:
+        """Wire surface of the TE optimizer.  Params: ``demand`` as
+        [[src, dest, volume], ...], ``metricLo``/``metricHi`` bounds,
+        ``steps`` descent budget, ``area``.  The reply's proposed
+        metrics come from the exact uint32 validation gate — never from
+        the smoothed model."""
+        serving = self._need(self.serving, "serving")
+        fut = serving.submit(
+            "optimize_metrics",
+            area=p.get("area", "0"),
+            demand=[
+                (row[0], row[1], row[2]) for row in (p.get("demand") or [])
+            ],
+            bounds=(p.get("metricLo", 1), p.get("metricHi", 64)),
+            steps=p.get("steps", 32),
+        )
+        res = await asyncio.wrap_future(fut)
+        return {
+            "result": res.value,
+            "epoch": res.epoch,
+            "batchSize": res.batch_size,
+            "latencyUs": res.latency_us,
+        }
+
     @staticmethod
     def _shape_query_value(op: str, value) -> Any:
         if op == "paths":
@@ -362,6 +394,7 @@ class OpenrCtrlHandler:
             self.device,
             self.serving,
             self.mesh,
+            self.te,
         ):
             if module is None:
                 continue

@@ -359,6 +359,10 @@ class OpenrDaemon:
                 None,
             ),
             serving=self.serving,
+            # TE optimizer counters (te.*, pre-seeded at construction)
+            # ride the same surface; the optimizer lives on the serving
+            # backend so optimizeMetrics runs and counter reads agree
+            te=getattr(self.serving.backend, "te", None),
             kvstore_updates_queue=self.kvstore_updates_queue,
             fib_updates_queue=self.fib_updates_queue,
             config_store=self.config_store,

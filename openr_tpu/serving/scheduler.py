@@ -66,7 +66,7 @@ _DEFER_MAX_S = 0.05
 # dispatch; each retry re-reads the epoch and recomputes fresh
 _MAX_EPOCH_RETRIES = 3
 
-_OPS = ("paths", "what_if", "ksp")
+_OPS = ("paths", "what_if", "ksp", "optimize_metrics")
 
 
 class QueryShedError(RuntimeError):
@@ -80,13 +80,16 @@ class Query:
     """One client question.  `sources`/`dests`/`scenarios` are tuples so
     queries are hashable and batch keys stay value-typed."""
 
-    op: str  # "paths" | "what_if" | "ksp"
+    op: str  # "paths" | "what_if" | "ksp" | "optimize_metrics"
     area: str = "0"
     sources: tuple = ()
     scenarios: tuple = ()  # what_if: tuple of scenario link tuples
     dests: tuple = ()  # ksp
     k: int = 2  # ksp
     use_link_metric: bool = True  # paths
+    demand: tuple = ()  # optimize_metrics: ((src, dest, volume), ...)
+    bounds: tuple = (1, 64)  # optimize_metrics: (metric_lo, metric_hi)
+    steps: int = 32  # optimize_metrics: descent steps
 
 
 @dataclass
@@ -205,6 +208,9 @@ class QueryScheduler(OpenrEventBase):
         dests=(),
         k: int = 2,
         use_link_metric: bool = True,
+        demand=(),
+        bounds=(1, 64),
+        steps: int = 32,
     ) -> "concurrent.futures.Future[QueryResult]":
         """Enqueue one query; returns a future resolving to QueryResult
         or raising QueryShedError / the compute error.  Never blocks the
@@ -219,6 +225,11 @@ class QueryScheduler(OpenrEventBase):
             dests=tuple(dests),
             k=int(k),
             use_link_metric=bool(use_link_metric),
+            demand=tuple(
+                (str(s), str(d), float(v)) for (s, d, v) in demand
+            ),
+            bounds=(int(bounds[0]), int(bounds[1])),
+            steps=int(steps),
         )
         fut: "concurrent.futures.Future[QueryResult]" = (
             concurrent.futures.Future()
@@ -258,6 +269,14 @@ class QueryScheduler(OpenrEventBase):
             # what-if impact counting is relative to the source set, so
             # only identical views coalesce (scenarios concatenate)
             return ("what_if", query.area, epoch, query.sources)
+        if query.op == "optimize_metrics":
+            # only IDENTICAL optimization requests coalesce (same demand
+            # matrix, bounds, budget): they share one descent run and one
+            # answer; anything else is its own batch
+            return (
+                "optimize_metrics", query.area, epoch, query.demand,
+                query.bounds, query.steps,
+            )
         return ("ksp", query.area, epoch, query.sources, query.k)
 
     async def prepare(self) -> None:
@@ -345,7 +364,15 @@ class QueryScheduler(OpenrEventBase):
         try:
             per_query: Optional[list] = None
             error: Optional[Exception] = None
-            for _attempt in range(_MAX_EPOCH_RETRIES):
+            # optimize_metrics never retries an epoch mismatch: a flap
+            # mid-descent means the whole run optimized a topology that
+            # no longer exists — the run aborts loudly (the caller sees
+            # EpochMismatchError) instead of silently re-pinning and
+            # publishing metrics tuned for the stale graph
+            attempts = (
+                1 if batch.op == "optimize_metrics" else _MAX_EPOCH_RETRIES
+            )
+            for _attempt in range(attempts):
                 try:
                     per_query = self._run_batch(batch)
                     error = None
@@ -400,6 +427,18 @@ class QueryScheduler(OpenrEventBase):
         """One backend call for the whole batch; returns per-query values
         aligned with batch.pendings."""
         queries = [p.query for p in batch.pendings]
+        if batch.op == "optimize_metrics":
+            # the batch key made every member identical: ONE descent run
+            # (epoch-checked per step by the optimizer) answers them all
+            q = queries[0]
+            result = self.backend.run_optimize_metrics(
+                batch.area,
+                q.demand,
+                q.bounds,
+                steps=q.steps,
+                expect_epoch=batch.epoch,
+            )
+            return [result for _ in queries]
         if batch.op == "paths":
             # stable-order union of every query's sources
             merged = list(

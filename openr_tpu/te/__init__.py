@@ -1,0 +1,21 @@
+"""Differentiable traffic engineering on the fleet product.
+
+The relax pipeline answers routing queries; this package *optimizes*
+the network: link metrics become parameters, a smoothed (softmin /
+log-sum-exp, temperature-annealed) float32 variant of the fleet
+min-plus product feeds a traffic-matrix load model, and projected
+gradient descent minimizes max-utilization on device.  Rounded integer
+candidates are validated through the EXACT uint32 solver
+(ops.allsources.reduced_all_sources) and only an exactly-improving
+candidate is ever published — the smoothed model is a search direction,
+never a source of truth.  Ground: gradient-descent TE with learned
+differentiable routing (PAPERS.md, arxiv 2209.10380).
+"""
+
+from .optimizer import (  # noqa: F401
+    TE_COUNTER_KEYS,
+    TeOptimizer,
+    TeProblem,
+    TeResult,
+    hill_climb,
+)

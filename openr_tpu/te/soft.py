@@ -1,0 +1,213 @@
+"""Smoothed float32 fleet product + traffic load model (the TE forward
+pass).
+
+Three jit roots, the ONLY float-allowlisted programs in the tree
+(pyproject `program_float_allowed`); everything they feed downstream —
+candidate acceptance, publication — goes through the exact uint32
+solver in te.exact, never through these.
+
+- `soft_sssp` — temperature-annealed softmin relaxation of the reverse
+  all-sources product: dist[v, p] smoothly approximates the exact
+  min-plus distance v -> dest p, and converges to it as tau -> 0
+  (softmin <= min <= softmin + tau * log(#paths)).  Same orientation
+  and drain rule as ops.allsources: an overloaded node relays nothing
+  but remains a valid endpoint (its own distance-0 row).
+- `soft_objective_value` — the load model + objective without the
+  backward pass (temperature sweeps, acceptance diagnostics).
+- `te_descent_step` — one fused Adam step: value_and_grad of the
+  objective w.r.t. the metric vector, moment updates, and projection
+  onto the [lo, hi] box, all in one program so the descent loop stays
+  on device between exact-validation round trips.
+
+Load model: demand[n, p] (traffic from node n to destination p) splits
+at every hop over soft-ECMP gate weights
+``w(e) = exp(-(metric(e) + dist(v,p) - dist(u,p)) / tau)`` (normalized
+per source node), propagated a fixed number of hop-sweeps; per-link
+utilization is the dest-summed load over capacity, and the objective is
+the log-sum-exp softmax of utilization over links — max-utilization
+with a usable gradient everywhere.
+
+Numerical discipline: every softmin is computed against a
+stop-gradient exact-min shift, so the log-sum-exp argument always
+contains a term with exponent 0 — no underflow-to-log(0), no NaN in
+the backward pass, at any temperature in the schedule.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+# float INF sentinel: far above any reachable distance (metrics are
+# bounded by the integer box, paths by the sweep count) yet small enough
+# that INF / tau never overflows exp's argument range in float32
+INF_F = np.float32(1.0e7)
+
+# strong-typed float32 scalars for every constant that enters a traced
+# program: a bare Python float literal traces as a WEAK float32, which
+# the program-dtype auditor bans even for float-allowlisted roots (weak
+# types are how accidental promotions propagate)
+_ZERO = np.float32(0.0)
+_HALF = np.float32(0.5)
+_ONE = np.float32(1.0)
+_NEG_INF = np.float32(-np.inf)
+_TINY = np.float32(1e-20)
+
+# Adam moments (fixed; the schedule knobs that matter — lr, tau — are
+# traced operands so one compiled program serves the whole anneal)
+_ADAM_B1 = np.float32(0.9)
+_ADAM_B2 = np.float32(0.999)
+_ADAM_EPS = np.float32(1e-8)
+
+
+def _softmin_sweep(dist, edge_src, edge_dst, metric_f, edge_up,
+                   node_overloaded, dest_ids, tau):
+    """One softmin relaxation sweep of dist [N_cap, P] (float32)."""
+    n_cap = dist.shape[0]
+    p_dim = dist.shape[1]
+    dv = dist[edge_dst]  # [E, P]
+    # drain rule: an overloaded node is excluded as a relay unless it is
+    # the destination itself (its distance-0 row) — metrics are >= 1 so
+    # the 0.5 threshold is exact even under softmin erosion
+    drained = node_overloaded[edge_dst][:, None] & (dv > _HALF)
+    ok = edge_up[:, None] & ~drained
+    cand = jnp.where(ok, metric_f[:, None] + dv, INF_F)
+    # pure Bellman relaxation: new_u = softmin_e(metric_e + dist_v) over
+    # u's out-edges ONLY.  Folding the previous dist into the softmin
+    # would re-count the incumbent at every sweep and erode all
+    # distances by tau*log(2) per iteration; the out-edge-only form has
+    # the proper fixed point d_u = -tau*log(sum_paths exp(-len/tau)),
+    # which the sweeps approach monotonically from the INF start.
+    shift = lax.stop_gradient(
+        jax.ops.segment_min(cand, edge_src, num_segments=n_cap)
+    )
+    contrib = jnp.exp((shift[edge_src] - cand) / tau)
+    seg_sum = jax.ops.segment_sum(contrib, edge_src, num_segments=n_cap)
+    # no usable out-edge -> stay unreachable; the safe-log double-where
+    # keeps NaN out of the backward pass
+    reach = seg_sum > _ZERO
+    safe = jnp.where(reach, seg_sum, _ONE)
+    new = jnp.where(reach, shift - tau * jnp.log(safe), INF_F)
+    new = jnp.clip(new, _ZERO, INF_F)
+    return new.at[dest_ids, jnp.arange(p_dim)].set(_ZERO)
+
+
+def _soft_sssp(edge_src, edge_dst, metric_f, edge_up, node_overloaded,
+               dest_ids, tau, n_sweeps, n_cap):
+    p_dim = dest_ids.shape[0]
+    dist = jnp.full((n_cap, p_dim), INF_F, dtype=jnp.float32)
+    dist = dist.at[dest_ids, jnp.arange(p_dim)].set(_ZERO)
+
+    def body(carry, _):
+        return (
+            _softmin_sweep(carry, edge_src, edge_dst, metric_f, edge_up,
+                           node_overloaded, dest_ids, tau),
+            None,
+        )
+
+    # scan (not fori/while): the descent root reverse-differentiates
+    # through these sweeps
+    dist, _ = lax.scan(body, dist, None, length=n_sweeps)
+    return dist
+
+
+@functools.partial(jax.jit, static_argnames=("n_sweeps",))
+def soft_sssp(edge_src, edge_dst, metric_f, edge_up, node_overloaded,
+              dest_ids, tau, *, n_sweeps):
+    """dist [N_cap, P] float32 — softmin distances to each destination
+    column at temperature ``tau`` (a traced scalar: annealing never
+    recompiles)."""
+    return _soft_sssp(
+        edge_src, edge_dst, metric_f, edge_up, node_overloaded, dest_ids,
+        jnp.float32(tau), n_sweeps, node_overloaded.shape[0],
+    )
+
+
+def _soft_loads(dist, edge_src, edge_dst, metric_f, edge_up,
+                node_overloaded, demand, tau, flow_sweeps):
+    """Per-edge dest-summed load [E_cap] from soft-ECMP demand splits."""
+    n_cap = dist.shape[0]
+    du = dist[edge_src]  # [E, P]
+    dv = dist[edge_dst]
+    drained = node_overloaded[edge_dst][:, None] & (dv > _HALF)
+    # a destination forwards nothing (du ~ 0) and an unreachable source
+    # carries nothing; both gates keep the normalizer honest
+    fwd = (
+        edge_up[:, None]
+        & ~drained
+        & (du > _HALF)
+        & (du < np.float32(INF_F * _HALF))
+    )
+    gap = metric_f[:, None] + dv - du
+    w = jnp.where(fwd, jnp.exp(-gap / tau), _ZERO)
+    z = jax.ops.segment_sum(w, edge_src, num_segments=n_cap)
+    wn = w / (z[edge_src] + _TINY)
+
+    def body(carry, _):
+        f, load = carry
+        fe = f[edge_src] * wn  # [E, P] flow pushed over each edge
+        return (jax.ops.segment_sum(fe, edge_dst, num_segments=n_cap),
+                load + fe), None
+
+    (_, load), _ = lax.scan(
+        body, (demand, jnp.zeros_like(w)), None, length=flow_sweeps
+    )
+    return jnp.sum(load, axis=1)
+
+
+def _objective(metric_f, edge_src, edge_dst, edge_up, node_overloaded,
+               dest_ids, demand, capacity, tau, tau_obj, n_sweeps,
+               flow_sweeps):
+    """Soft max-utilization: log-sum-exp over per-link utilization."""
+    dist = _soft_sssp(
+        edge_src, edge_dst, metric_f, edge_up, node_overloaded, dest_ids,
+        tau, n_sweeps, node_overloaded.shape[0],
+    )
+    load = _soft_loads(
+        dist, edge_src, edge_dst, metric_f, edge_up, node_overloaded,
+        demand, tau, flow_sweeps,
+    )
+    util = load / capacity
+    masked = jnp.where(edge_up, util, _NEG_INF)
+    return tau_obj * jax.nn.logsumexp(masked / tau_obj)
+
+
+@functools.partial(jax.jit, static_argnames=("n_sweeps", "flow_sweeps"))
+def soft_objective_value(metric_f, edge_src, edge_dst, edge_up,
+                         node_overloaded, dest_ids, demand, capacity,
+                         tau, tau_obj, *, n_sweeps, flow_sweeps):
+    """Forward-only objective (temperature sweeps, diagnostics)."""
+    return _objective(
+        metric_f, edge_src, edge_dst, edge_up, node_overloaded, dest_ids,
+        demand, capacity, jnp.float32(tau), jnp.float32(tau_obj),
+        n_sweeps, flow_sweeps,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("n_sweeps", "flow_sweeps"))
+def te_descent_step(metric_f, adam_m, adam_v, t, edge_src, edge_dst,
+                    edge_up, node_overloaded, dest_ids, demand, capacity,
+                    tau, tau_obj, lr, lo, hi, *, n_sweeps, flow_sweeps):
+    """One projected-Adam step on the metric vector.
+
+    Returns (objective, metric', m', v').  ``t`` (1-based step index,
+    float32) drives the bias correction; lr/tau/lo/hi ride as traced
+    scalars so the whole anneal reuses one compiled program.
+    """
+    obj, grad = jax.value_and_grad(_objective)(
+        metric_f, edge_src, edge_dst, edge_up, node_overloaded, dest_ids,
+        demand, capacity, jnp.float32(tau), jnp.float32(tau_obj),
+        n_sweeps, flow_sweeps,
+    )
+    grad = jnp.where(edge_up, grad, _ZERO)  # padding metrics stay put
+    m = _ADAM_B1 * adam_m + (_ONE - _ADAM_B1) * grad
+    v = _ADAM_B2 * adam_v + (_ONE - _ADAM_B2) * grad * grad
+    mh = m / (_ONE - jnp.power(_ADAM_B1, t))
+    vh = v / (_ONE - jnp.power(_ADAM_B2, t))
+    step = lr * mh / (jnp.sqrt(vh) + _ADAM_EPS)
+    new = jnp.clip(metric_f - step, lo, hi)
+    return obj, new, m, v

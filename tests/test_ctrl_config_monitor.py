@@ -425,6 +425,9 @@ class TestCounterRegistrySweep:
                 # the blocked node-sharding rung pre-seeds mesh.blocked.*
                 # in the engine's sub-registry before any product runs
                 "mesh.blocked.products",
+                # the TE optimizer pre-seeds te.* at construction, so the
+                # family is dumpable before any optimizeMetrics runs
+                "te.runs",
             ):
                 assert key in counters, f"{key} missing from getCounters"
 
@@ -616,3 +619,72 @@ class TestCounterRegistrySweep:
             shim.wait_until_stopped(5)
         assert set(DELTA_COUNTER_KEYS) <= set(shimmed)
         assert set(engine_delta) <= set(shimmed)
+
+    def test_te_family_on_both_wire_surfaces(self, daemon):
+        """The full te.* registry (runs, steps, round trips, accept /
+        reject / abort ledgers, objective gauges) answers ONE getCounters
+        on the native ctrl server AND the fb303 shim, pre-seeded at
+        TeOptimizer construction — dashboards can alert on te.aborted or
+        te.rejected going non-zero before the first optimizeMetrics ever
+        runs."""
+        import re
+
+        from openr_tpu.interop import thrift_binary as tb
+        from openr_tpu.interop.shim import ThriftBinaryShim
+        from openr_tpu.te import TE_COUNTER_KEYS
+        from test_thrift_binary import _call_ok
+
+        client = CtrlClient(port=daemon.ctrl_port)
+        try:
+            native = client.call("getCounters")
+        finally:
+            client.close()
+        assert set(TE_COUNTER_KEYS) <= set(native)
+
+        name_re = re.compile(r"[a-z][a-z0-9_]*(\.[a-z0-9_]+)+\Z")
+        assert all(name_re.match(k) for k in TE_COUNTER_KEYS)
+
+        shim = ThriftBinaryShim(
+            daemon.kvstore,
+            port=0,
+            node_name="solo",
+            counters_fn=daemon.ctrl_server.handler._all_counters,
+        )
+        shim.run()
+        try:
+            shimmed = _call_ok(
+                shim.port,
+                "getCounters",
+                44,
+                b"\x00",
+                ("map", tb.T_STRING, tb.T_I64),
+                dec=lambda m: {k.decode(): v for k, v in m.items()},
+            )
+        finally:
+            shim.stop()
+            shim.wait_until_stopped(5)
+        assert set(TE_COUNTER_KEYS) <= set(shimmed)
+        # representative key round-trips the strict-binary i64 map intact
+        assert shimmed["te.runs"] == native["te.runs"]
+
+
+class TestOptimizeMetricsWire:
+    """The ctrl optimizeMetrics front-end end to end: a bad request is
+    answered with a clean error envelope through the serving admission
+    path — never a hang, never a silent drop (tests/test_te.py covers
+    the optimizer itself; this pins the wire registration)."""
+
+    def test_bad_demand_gets_clean_error(self, daemon):
+        client = CtrlClient(port=daemon.ctrl_port)
+        try:
+            with pytest.raises(RuntimeError):
+                client.call(
+                    "optimizeMetrics",
+                    area="0",
+                    demand=[["no-such-node", "also-missing", 1.0]],
+                    steps=2,
+                )
+            # the surface stays alive and dumpable after the error
+            assert "te.runs" in client.call("getCounters")
+        finally:
+            client.close()

@@ -1337,6 +1337,75 @@ class TestLabelRangeEdges:
         assert 500 in db.mpls_routes
 
 
+class TestLabelRangeExhaustion:
+    """Ancestors: MplsRoutes.BasicTest label validity (DecisionTest.cpp
+    :737-780) x DuplicateMplsRoutes (:2037) — the EXHAUSTION corner of
+    the 20-bit space: node labels packing the last valid slots, an
+    allocator that wrapped past the edge, and a collision on the final
+    slot.  Distinct from TestLabelRangeEdges (single boundary labels):
+    these cases interact several top-of-range labels in one topology,
+    against the engine-backed solver pair via routes()."""
+
+    def _ring_ls(self, labels):
+        return build_link_state(
+            {
+                "1": [adj("1", "2"), adj("1", "4")],
+                "2": [adj("2", "1"), adj("2", "3")],
+                "3": [adj("3", "2"), adj("3", "4")],
+                "4": [adj("4", "3"), adj("4", "1")],
+            },
+            labels=labels,
+        )
+
+    def test_top_of_range_packs_without_collision(self):
+        # the last four valid slots all program: no off-by-one at the
+        # 2^20-1 ceiling when neighbors also sit at the ceiling
+        hi = (1 << 20) - 1
+        ls = self._ring_ls(
+            {"1": hi - 3, "2": hi - 2, "3": hi - 1, "4": hi}
+        )
+        ps = prefix_state_with(("3", "0", PrefixEntry(prefix=PFX)))
+        db = routes("1", {"0": ls}, ps)
+        for lbl in (hi - 2, hi - 1, hi):
+            assert lbl in db.mpls_routes, lbl
+        assert PFX in db.unicast_routes
+
+    def test_exhausted_allocator_collides_on_final_slot(self):
+        # exhaustion symptom: two nodes claim the one remaining slot;
+        # exactly one route programs for it and the rest of the space
+        # still resolves (the duplicate-label rule at the range edge)
+        hi = (1 << 20) - 1
+        ls = self._ring_ls({"1": hi - 1, "2": hi, "3": hi, "4": 105})
+        db = routes("4", {"0": ls}, PrefixState())
+        assert hi in db.mpls_routes
+        assert len(db.mpls_routes[hi].nexthops) >= 1
+        assert (hi - 1) in db.mpls_routes
+        assert 105 in db.mpls_routes  # own POP_AND_LOOKUP intact
+
+    def test_wrap_past_max_skipped_then_recovered_into_free_slot(self):
+        # an allocator that wrapped past the edge emits 2^20: invalid,
+        # skipped (unicast untouched); relabeling into the still-free
+        # top slot recovers the MPLS route — the operator remediation
+        hi = (1 << 20) - 1
+        ls = self._ring_ls(
+            {"1": hi - 2, "2": hi - 1, "3": hi + 1, "4": 105}
+        )
+        ps = prefix_state_with(("3", "0", PrefixEntry(prefix=PFX)))
+        db = routes("1", {"0": ls}, ps)
+        assert (hi + 1) not in db.mpls_routes
+        assert PFX in db.unicast_routes
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="3",
+                adjacencies=[adj("3", "2"), adj("3", "4")],
+                node_label=hi,
+                area="0",
+            )
+        )
+        db = routes("1", {"0": ls}, ps)
+        assert hi in db.mpls_routes
+
+
 class TestMultiEventSequences:
     """Ancestors: the longer DecisionTestFixture sequences
     (BasicOperations :4787, PubDebouncing :6024, DuplicatePrefixes

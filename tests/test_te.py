@@ -1,0 +1,420 @@
+"""Differentiable-TE subsystem tests (openr_tpu/te).
+
+Acceptance contract (ISSUE 10):
+(a) the soft objective/distances converge to the exact solver's as the
+    temperature anneals to 0 on ring/grid/fattree;
+(b) gradients are finite and nonzero under jax.grad on a seeded
+    wan-shaped topology;
+(c) end-to-end optimize on a seeded congested topology strictly
+    improves the EXACT max-utilization and beats-or-matches the host
+    hill-climb baseline;
+(d) every published metric set is integer, within bounds, and
+    exactly validated — a structurally always-reject case shows
+    te.rejected incrementing and NO publication;
+(e) a mid-run epoch flap aborts loudly (EpochMismatchError) with
+    counters accounted, at the optimizer and at the serving scheduler
+    (which never retries this op).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from benchmarks import synthetic as syn
+from openr_tpu.device.engine import EpochMismatchError
+from openr_tpu.te import TE_COUNTER_KEYS, TeOptimizer, TeProblem, hill_climb
+from openr_tpu.te import soft
+from openr_tpu.te.exact import INF32, ExactEvaluator
+
+pytestmark = pytest.mark.te
+
+# shared sweep budget: deeper than every test topology's diameter, and a
+# single value so the jitted soft kernels compile once per array shape
+_SWEEPS = 16
+
+
+def _ring(n: int = 12):
+    links = np.array([[i, (i + 1) % n] for i in range(n)])
+    mets = np.tile([1, 1], (n, 1))
+    return syn.Topology.from_links("ring", n, links, mets)
+
+
+def _diamond():
+    """The seeded congested case: all demand rides the cheap 0-1-3 path
+    (exact max-util 8.0); splitting over 0-2-3 halves it — reachable
+    only by raising metrics, which descent must discover."""
+    links = np.array([[0, 1], [1, 3], [0, 2], [2, 3]])
+    mets = np.array([[1, 1], [1, 1], [2, 2], [2, 2]])
+    return syn.Topology.from_links("diamond", 4, links, mets)
+
+
+def _chain():
+    """Structurally always-reject: one path 0-1-2, so utilization is
+    metric-invariant and no candidate can strictly improve."""
+    links = np.array([[0, 1], [1, 2]])
+    mets = np.array([[1, 1], [1, 1]])
+    return syn.Topology.from_links("chain", 3, links, mets)
+
+
+def _problem(topo, dest_ids, demand_pairs, lo=1, hi=16):
+    """demand_pairs: {(src_id, dest_col): volume}."""
+    dest_ids = np.asarray(dest_ids, dtype=np.int32)
+    dm = np.zeros((topo.node_capacity, len(dest_ids)), dtype=np.float32)
+    for (s, j), v in demand_pairs.items():
+        dm[s, j] = v
+    return TeProblem.from_topology(
+        topo, dest_ids, dm, metric_lo=lo, metric_hi=hi
+    )
+
+
+def _evaluator(problem, engine=None):
+    return ExactEvaluator(
+        problem.edge_src, problem.edge_dst, problem.edge_up,
+        problem.node_overloaded, problem.n_edges, problem.n_nodes,
+        problem.dest_ids, problem.demand, problem.capacity, engine=engine,
+    )
+
+
+def _soft_dist(problem, tau):
+    import jax.numpy as jnp
+
+    return np.asarray(
+        soft.soft_sssp(
+            jnp.asarray(problem.edge_src),
+            jnp.asarray(problem.edge_dst),
+            jnp.asarray(problem.edge_metric, dtype=jnp.float32),
+            jnp.asarray(problem.edge_up),
+            jnp.asarray(problem.node_overloaded),
+            jnp.asarray(problem.dest_ids),
+            np.float32(tau),
+            n_sweeps=_SWEEPS,
+        )
+    )
+
+
+class TestSoftConvergence:
+    """(a): soft distances/objective -> exact as tau -> 0."""
+
+    @pytest.mark.parametrize(
+        "topo,dests",
+        [
+            (_ring(), [0, 6]),
+            (syn.grid(4), [0, 15]),
+            (syn.fat_tree(2, 2, 2, 2), [0, 1]),
+        ],
+        ids=["ring", "grid", "fattree"],
+    )
+    def test_soft_distances_anneal_to_exact(self, topo, dests):
+        prob = _problem(
+            topo, dests, {(1, 0): 1.0, (2, 1): 1.0}
+        )
+        exact = _evaluator(prob).distances(prob.edge_metric)
+        n = prob.n_nodes
+        finite = exact[:n] < INF32
+        assert finite.any()
+        errs = []
+        for tau in (1.0, 0.5, 0.1, 0.02):
+            d = _soft_dist(prob, tau)
+            errs.append(
+                float(np.abs(d[:n][finite] - exact[:n][finite]).max())
+            )
+        # monotone-ish anneal: each temperature at least as close as the
+        # hotter one, and the coldest within ECMP-multiplicity tolerance
+        # (softmin undershoots min by exactly tau*log(#min paths))
+        assert all(a >= b - 1e-3 for a, b in zip(errs, errs[1:])), errs
+        assert errs[-1] < 0.5, errs
+        # unreachable stays unreachable: soft never invents a path
+        if (~finite).any():
+            assert (d[:n][~finite] > soft.INF_F * 0.5).all()
+
+    def test_soft_objective_tracks_exact_objective(self):
+        prob = _problem(_diamond(), [3], {(0, 0): 8.0}, hi=8)
+        ev = _evaluator(prob)
+        import jax.numpy as jnp
+
+        args = (
+            jnp.asarray(prob.edge_src), jnp.asarray(prob.edge_dst),
+            jnp.asarray(prob.edge_up), jnp.asarray(prob.node_overloaded),
+            jnp.asarray(prob.dest_ids),
+            jnp.asarray(prob.demand, dtype=jnp.float32),
+            jnp.asarray(prob.capacity, dtype=jnp.float32),
+        )
+        for metric, expect in (
+            (prob.edge_metric, 8.0),  # all demand on the cheap path
+            (np.where(prob.edge_up, 2, 1).astype(np.int32), 4.0),  # split
+        ):
+            assert ev.evaluate(metric) == pytest.approx(expect)
+            got = float(
+                soft.soft_objective_value(
+                    jnp.asarray(metric, dtype=jnp.float32), *args,
+                    np.float32(0.02), np.float32(0.01),
+                    n_sweeps=_SWEEPS, flow_sweeps=_SWEEPS,
+                )
+            )
+            assert got == pytest.approx(expect, rel=0.05)
+
+
+class TestGradients:
+    """(b): finite, nonzero gradients on a seeded wan-shaped topology."""
+
+    def test_grad_finite_nonzero_on_wan(self):
+        import jax
+        import jax.numpy as jnp
+
+        topo = syn.wan(n_nodes=192, chords=2, seed=7)
+        rng = np.random.RandomState(7)
+        dests = np.array([3, 90], dtype=np.int32)
+        dm = np.zeros((topo.node_capacity, 2), dtype=np.float32)
+        dm[: topo.n_nodes] = rng.uniform(
+            0.0, 1.0, size=(topo.n_nodes, 2)
+        ).astype(np.float32)
+        prob = TeProblem.from_topology(topo, dests, dm, metric_hi=64)
+
+        def objective(metric_f):
+            return soft.soft_objective_value(
+                metric_f,
+                jnp.asarray(prob.edge_src), jnp.asarray(prob.edge_dst),
+                jnp.asarray(prob.edge_up),
+                jnp.asarray(prob.node_overloaded),
+                jnp.asarray(prob.dest_ids),
+                jnp.asarray(prob.demand, dtype=jnp.float32),
+                jnp.asarray(prob.capacity, dtype=jnp.float32),
+                np.float32(0.5), np.float32(0.1),
+                n_sweeps=32, flow_sweeps=32,
+            )
+
+        grad = np.asarray(
+            jax.grad(objective)(
+                jnp.asarray(prob.edge_metric, dtype=jnp.float32)
+            )
+        )
+        assert np.isfinite(grad).all()
+        assert np.abs(grad[: prob.n_edges]).max() > 0.0
+        # padding edges are dead weight: no gradient may leak into them
+        assert (grad[~prob.edge_up] == 0.0).all()
+
+
+class TestOptimizeEndToEnd:
+    """(c)+(d): exact improvement, baseline comparison, publication
+    discipline."""
+
+    def test_congested_diamond_improves_and_beats_hill_climb(self):
+        prob = _problem(_diamond(), [3], {(0, 0): 8.0}, hi=8)
+        ev = _evaluator(prob)
+        opt = TeOptimizer()
+        published = []
+        res = opt.optimize(
+            prob, steps=36, round_trips=3, n_sweeps=8, flow_sweeps=8,
+            publish=lambda m, o: published.append((m, o)),
+        )
+        # strict exact improvement, via the exact gate
+        assert res.objective_before == pytest.approx(8.0)
+        assert res.objective_after < res.objective_before
+        assert res.improved and res.accepted >= 1
+        # the returned metrics REPRODUCE the claimed exact objective
+        assert ev.evaluate(res.metrics) == pytest.approx(
+            res.objective_after
+        )
+        # beats-or-matches the host hill-climb baseline
+        _hm, hill_obj, _evals = hill_climb(prob, rounds=24, seed=3)
+        assert res.objective_after <= hill_obj + 1e-12
+        # exactly one publication, of the validated integer metrics
+        assert len(published) == 1
+        pm, pobj = published[0]
+        assert pobj == pytest.approx(res.objective_after)
+        assert pm.dtype == np.int32
+        live = pm[: prob.n_edges][prob.edge_up[: prob.n_edges]]
+        assert (live >= prob.metric_lo).all()
+        assert (live <= prob.metric_hi).all()
+        counters = opt.get_counters()
+        assert counters["te.accepted"] == res.accepted
+        assert counters["te.objective_after_milli"] < counters[
+            "te.objective_before_milli"
+        ]
+
+    def test_always_reject_case_never_publishes(self):
+        # a chain's utilization is metric-invariant: every candidate is
+        # rejected by the exact gate and nothing publishes
+        prob = _problem(_chain(), [2], {(0, 0): 5.0}, hi=8)
+        opt = TeOptimizer()
+        published = []
+        res = opt.optimize(
+            prob, steps=12, round_trips=2, n_sweeps=8, flow_sweeps=8,
+            publish=lambda m, o: published.append((m, o)),
+        )
+        assert published == []
+        assert not res.improved
+        assert res.accepted == 0 and res.rejected == 2
+        counters = opt.get_counters()
+        assert counters["te.rejected"] == 2
+        assert counters["te.accepted"] == 0
+        # the result falls back to the INITIAL metrics: integer, in
+        # bounds, and exactly re-validated as the baseline objective
+        assert (res.metrics == np.where(
+            prob.edge_up, prob.edge_metric, 1
+        )).all()
+        assert res.objective_after == pytest.approx(res.objective_before)
+        assert _evaluator(prob).evaluate(res.metrics) == pytest.approx(
+            res.objective_before
+        )
+
+    def test_counter_keys_pre_seeded(self):
+        opt = TeOptimizer()
+        counters = opt.get_counters()
+        for key in TE_COUNTER_KEYS:
+            assert counters[key] == 0
+        pat = __import__("re").compile(
+            r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+)+$"
+        )
+        for key in TE_COUNTER_KEYS:
+            assert pat.match(key), key
+
+
+class TestEpochAbort:
+    """(e): a mid-run flap aborts loudly, counters accounted."""
+
+    def test_optimizer_aborts_on_epoch_flip(self):
+        prob = _problem(_diamond(), [3], {(0, 0): 8.0}, hi=8)
+        opt = TeOptimizer()
+        calls = {"n": 0}
+
+        def epoch_fn():
+            calls["n"] += 1
+            return 5 if calls["n"] <= 3 else 6  # the flap
+
+        published = []
+        with pytest.raises(EpochMismatchError) as ei:
+            opt.optimize(
+                prob, steps=12, round_trips=2, n_sweeps=8, flow_sweeps=8,
+                epoch_fn=epoch_fn, expect_epoch=5,
+                publish=lambda m, o: published.append(m),
+            )
+        assert ei.value.expected == 5 and ei.value.actual == 6
+        assert published == []
+        counters = opt.get_counters()
+        assert counters["te.aborted"] == 1
+        # the steps taken before the flap are accounted, none after
+        assert 0 < counters["te.steps"] < 12
+
+    def test_scheduler_never_retries_optimize_epoch_mismatch(self):
+        import sys
+
+        sys.path.insert(0, "tests") if "tests" not in sys.path else None
+        from openr_tpu.decision.spf_solver import DeviceSpfBackend
+        from openr_tpu.serving import EngineBatchBackend, QueryScheduler
+        from openr_tpu.types import AdjacencyDatabase
+        from test_spf_solver import adj, square
+
+        ls = square()
+        backend = EngineBatchBackend(
+            {"0": ls},
+            spf_backend=DeviceSpfBackend(
+                min_device_nodes=1, min_device_sources=1
+            ),
+        )
+        sched = QueryScheduler(backend)
+
+        def flap_on_execute(event, batch):
+            if event == "execute_begin" and batch.op == "optimize_metrics":
+                # the flap lands after coalescing pinned the epoch
+                ls.update_adjacency_database(
+                    AdjacencyDatabase(
+                        this_node_name="2",
+                        adjacencies=[adj("2", "1")],
+                        is_overloaded=False,
+                        node_label=102,
+                        area="0",
+                    )
+                )
+
+        sched.trace_hook = flap_on_execute
+        sched.run()
+        try:
+            fut = sched.submit(
+                "optimize_metrics",
+                demand=(("1", "3", 4.0),),
+                bounds=(1, 16),
+                steps=8,
+            )
+            with pytest.raises(EpochMismatchError):
+                fut.result(60)
+            counters = sched.get_counters()
+            # invalidation recorded, but NO retry: stale-tuned metrics
+            # must never be recomputed against a silently re-pinned epoch
+            assert counters["serving.invalidations"] == 1
+            assert counters["serving.errors"] == 1
+            assert counters["serving.replies"] == 0
+        finally:
+            sched.trace_hook = None
+            sched.stop()
+
+
+class TestServingSurface:
+    """optimizeMetrics rides admission/coalescing like every query op."""
+
+    def test_optimize_metrics_end_to_end_via_scheduler(self):
+        import sys
+
+        sys.path.insert(0, "tests") if "tests" not in sys.path else None
+        from openr_tpu.decision.spf_solver import DeviceSpfBackend
+        from openr_tpu.serving import EngineBatchBackend, QueryScheduler
+        from test_spf_solver import square
+
+        ls = square()
+        backend = EngineBatchBackend(
+            {"0": ls},
+            spf_backend=DeviceSpfBackend(
+                min_device_nodes=1, min_device_sources=1
+            ),
+        )
+        sched = QueryScheduler(backend)
+        sched.run()
+        try:
+            fut = sched.submit(
+                "optimize_metrics",
+                demand=(("1", "3", 4.0), ("2", "3", 2.0)),
+                bounds=(1, 16),
+                steps=24,
+            )
+            res = fut.result(120)
+            value = res.value
+            assert value["objectiveAfter"] <= value["objectiveBefore"]
+            assert res.epoch == int(ls.version)
+            for u, v, m in value["proposedMetrics"]:
+                assert isinstance(m, int)
+                assert 1 <= m <= 16
+                assert u in ls.node_names and v in ls.node_names
+            # te.* counters accounted on the backend's optimizer
+            counters = backend.te.get_counters()
+            assert counters["te.runs"] == 1
+            assert counters["te.steps"] == 24
+        finally:
+            sched.stop()
+
+
+@pytest.mark.slow
+class TestOptimizeSoak:
+    """Long optimization soak: a seeded wan-shaped instance, full
+    anneal, exact gate on every stage."""
+
+    def test_wan_soak_improves_or_holds(self):
+        topo = syn.wan(n_nodes=192, chords=2, seed=11)
+        rng = np.random.RandomState(11)
+        dests = np.array([0, 50, 120], dtype=np.int32)
+        dm = np.zeros((topo.node_capacity, 3), dtype=np.float32)
+        dm[: topo.n_nodes] = rng.uniform(
+            0.0, 2.0, size=(topo.n_nodes, 3)
+        ).astype(np.float32)
+        prob = TeProblem.from_topology(topo, dests, dm, metric_hi=64)
+        opt = TeOptimizer()
+        res = opt.optimize(
+            prob, steps=96, round_trips=6, n_sweeps=48, flow_sweeps=48
+        )
+        assert res.objective_after <= res.objective_before
+        ev = _evaluator(prob)
+        assert ev.evaluate(res.metrics) == pytest.approx(
+            res.objective_after
+        )
+        live = res.metrics[: prob.n_edges][prob.edge_up[: prob.n_edges]]
+        assert (live >= 1).all() and (live <= 64).all()

@@ -1,0 +1,674 @@
+"""Stock-Thrift generated-client interop against the fb303 shim.
+
+tests/test_thrift_binary.py drives the shim with the repo's OWN codec —
+a useful round trip, but one that would still pass if encoder and
+decoder shared a bug.  This file is the other half of the interop
+proof: the client side is a vendored slice of the Apache Thrift Python
+runtime (TSocket / TFramedTransport / TBinaryProtocol, strict mode)
+plus `thrift --gen py`-style generated classes for the OpenrCtrl slice
+(reference signatures openr/if/OpenrCtrl.thrift:398-612, field ids
+openr/if/Types.thrift:555 Value, :647 KeySetParams, :897 Publication),
+and imports NOTHING from openr_tpu — if our shim drifts from the
+thrift binary protocol, this client stops parsing it.
+
+The container has no `thrift` pip package, so the runtime classes are
+vendored here verbatim in shape (method names, envelope bytes, framing)
+rather than imported; only the server-side fixture touches openr_tpu.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+
+import pytest
+
+# ---------------------------------------------------------------------------
+# Vendored Apache-Thrift-style runtime (client side only, strict binary)
+# ---------------------------------------------------------------------------
+
+
+class TType:
+    STOP = 0
+    VOID = 1
+    BOOL = 2
+    BYTE = 3
+    DOUBLE = 4
+    I16 = 6
+    I32 = 8
+    I64 = 10
+    STRING = 11
+    STRUCT = 12
+    MAP = 13
+    SET = 14
+    LIST = 15
+
+
+class TTransportException(Exception):
+    pass
+
+
+class TApplicationException(Exception):
+    UNKNOWN_METHOD = 1
+
+    def __init__(self, type=0, message=None):
+        super().__init__(message)
+        self.type = type
+        self.message = message
+
+    def read(self, iprot):
+        iprot.readStructBegin()
+        while True:
+            _fname, ftype, fid = iprot.readFieldBegin()
+            if ftype == TType.STOP:
+                break
+            if fid == 1 and ftype == TType.STRING:
+                self.message = iprot.readString().decode()
+            elif fid == 2 and ftype == TType.I32:
+                self.type = iprot.readI32()
+            else:
+                iprot.skip(ftype)
+            iprot.readFieldEnd()
+        iprot.readStructEnd()
+
+
+class TSocket:
+    def __init__(self, host, port):
+        self.host, self.port = host, port
+        self.handle = None
+
+    def open(self):
+        self.handle = socket.create_connection(
+            (self.host, self.port), timeout=10
+        )
+
+    def close(self):
+        if self.handle is not None:
+            self.handle.close()
+            self.handle = None
+
+    def read(self, sz):
+        buff = self.handle.recv(sz)
+        if not buff:
+            raise TTransportException("TSocket read 0 bytes")
+        return buff
+
+    def write(self, buff):
+        self.handle.sendall(buff)
+
+    def flush(self):
+        pass
+
+
+class TFramedTransport:
+    def __init__(self, trans):
+        self.__trans = trans
+        self.__wbuf = b""
+        self.__rbuf = b""
+
+    def open(self):
+        self.__trans.open()
+
+    def close(self):
+        self.__trans.close()
+
+    def read(self, sz):
+        if not self.__rbuf:
+            self.readFrame()
+        ret, self.__rbuf = self.__rbuf[:sz], self.__rbuf[sz:]
+        return ret
+
+    def readFrame(self):
+        head = b""
+        while len(head) < 4:
+            head += self.__trans.read(4 - len(head))
+        (length,) = struct.unpack("!i", head)
+        data = b""
+        while len(data) < length:
+            data += self.__trans.read(length - len(data))
+        self.__rbuf = data
+
+    def write(self, buf):
+        self.__wbuf += buf
+
+    def flush(self):
+        out = struct.pack("!i", len(self.__wbuf)) + self.__wbuf
+        self.__wbuf = b""
+        self.__trans.write(out)
+        self.__trans.flush()
+
+
+class TBinaryProtocol:
+    """Strict-mode thrift binary protocol, write + read halves."""
+
+    VERSION_MASK = -65536  # 0xffff0000
+    VERSION_1 = -2147418112  # 0x80010000
+
+    def __init__(self, trans):
+        self.trans = trans
+
+    # -- write half --------------------------------------------------------
+
+    def writeMessageBegin(self, name, type, seqid):
+        self.writeI32(TBinaryProtocol.VERSION_1 | type)
+        self.writeString(name.encode())
+        self.writeI32(seqid)
+
+    def writeMessageEnd(self):
+        pass
+
+    def writeStructBegin(self, name):
+        pass
+
+    def writeStructEnd(self):
+        pass
+
+    def writeFieldBegin(self, name, type, id):
+        self.writeByte(type)
+        self.writeI16(id)
+
+    def writeFieldEnd(self):
+        pass
+
+    def writeFieldStop(self):
+        self.writeByte(TType.STOP)
+
+    def writeMapBegin(self, ktype, vtype, size):
+        self.writeByte(ktype)
+        self.writeByte(vtype)
+        self.writeI32(size)
+
+    def writeMapEnd(self):
+        pass
+
+    def writeListBegin(self, etype, size):
+        self.writeByte(etype)
+        self.writeI32(size)
+
+    def writeListEnd(self):
+        pass
+
+    def writeBool(self, bool_val):
+        self.writeByte(1 if bool_val else 0)
+
+    def writeByte(self, byte):
+        self.trans.write(struct.pack("!b", byte))
+
+    def writeI16(self, i16):
+        self.trans.write(struct.pack("!h", i16))
+
+    def writeI32(self, i32):
+        self.trans.write(struct.pack("!i", i32))
+
+    def writeI64(self, i64):
+        self.trans.write(struct.pack("!q", i64))
+
+    def writeString(self, s):
+        if isinstance(s, str):
+            s = s.encode()
+        self.writeI32(len(s))
+        self.trans.write(s)
+
+    # -- read half ---------------------------------------------------------
+
+    def readMessageBegin(self):
+        sz = self.readI32()
+        if sz >= 0:
+            raise TTransportException("old-style (unstrict) reply")
+        version = sz & TBinaryProtocol.VERSION_MASK
+        if version != TBinaryProtocol.VERSION_1 & 0xFFFFFFFF and version != (
+            TBinaryProtocol.VERSION_1 & TBinaryProtocol.VERSION_MASK
+        ):
+            raise TTransportException("bad version in readMessageBegin")
+        type = sz & 0x000000FF
+        name = self.readString().decode()
+        seqid = self.readI32()
+        return (name, type, seqid)
+
+    def readMessageEnd(self):
+        pass
+
+    def readStructBegin(self):
+        pass
+
+    def readStructEnd(self):
+        pass
+
+    def readFieldBegin(self):
+        type = self.readByte()
+        if type == TType.STOP:
+            return (None, type, 0)
+        id = self.readI16()
+        return (None, type, id)
+
+    def readFieldEnd(self):
+        pass
+
+    def readMapBegin(self):
+        ktype = self.readByte()
+        vtype = self.readByte()
+        size = self.readI32()
+        return (ktype, vtype, size)
+
+    def readMapEnd(self):
+        pass
+
+    def readListBegin(self):
+        etype = self.readByte()
+        size = self.readI32()
+        return (etype, size)
+
+    def readListEnd(self):
+        pass
+
+    def readBool(self):
+        return self.readByte() != 0
+
+    def readByte(self):
+        return struct.unpack("!b", self._readAll(1))[0]
+
+    def readI16(self):
+        return struct.unpack("!h", self._readAll(2))[0]
+
+    def readI32(self):
+        return struct.unpack("!i", self._readAll(4))[0]
+
+    def readI64(self):
+        return struct.unpack("!q", self._readAll(8))[0]
+
+    def readString(self):
+        return self._readAll(self.readI32())
+
+    def _readAll(self, sz):
+        buff = b""
+        while len(buff) < sz:
+            buff += self.trans.read(sz - len(buff))
+        return buff
+
+    def skip(self, ttype):
+        if ttype == TType.BOOL or ttype == TType.BYTE:
+            self.readByte()
+        elif ttype == TType.I16:
+            self.readI16()
+        elif ttype == TType.I32:
+            self.readI32()
+        elif ttype == TType.I64:
+            self.readI64()
+        elif ttype == TType.DOUBLE:
+            self._readAll(8)
+        elif ttype == TType.STRING:
+            self.readString()
+        elif ttype == TType.STRUCT:
+            self.readStructBegin()
+            while True:
+                _n, ftype, _fid = self.readFieldBegin()
+                if ftype == TType.STOP:
+                    break
+                self.skip(ftype)
+                self.readFieldEnd()
+            self.readStructEnd()
+        elif ttype == TType.MAP:
+            ktype, vtype, size = self.readMapBegin()
+            for _ in range(size):
+                self.skip(ktype)
+                self.skip(vtype)
+            self.readMapEnd()
+        elif ttype == TType.SET or ttype == TType.LIST:
+            etype, size = self.readListBegin()
+            for _ in range(size):
+                self.skip(etype)
+            self.readListEnd()
+        else:
+            raise TTransportException(f"cannot skip type {ttype}")
+
+
+# ---------------------------------------------------------------------------
+# `thrift --gen py`-style generated code: the OpenrCtrl kvstore slice
+# (openr/if/OpenrCtrl.thrift:398-612; Types.thrift Value/KeySetParams/
+# Publication field ids)
+# ---------------------------------------------------------------------------
+
+CALL, REPLY, EXCEPTION = 1, 2, 3
+
+
+class Value_:
+    """openr.thrift.Value — ids 1 version, 2 value, 3 originatorId,
+    4 ttl, 5 ttlVersion, 6 hash (NOT declaration order)."""
+
+    def __init__(self, version=None, originatorId=None, value=None,
+                 ttl=None, ttlVersion=0, hash=None):
+        self.version = version
+        self.originatorId = originatorId
+        self.value = value
+        self.ttl = ttl
+        self.ttlVersion = ttlVersion
+        self.hash = hash
+
+    def read(self, iprot):
+        iprot.readStructBegin()
+        while True:
+            _fname, ftype, fid = iprot.readFieldBegin()
+            if ftype == TType.STOP:
+                break
+            if fid == 1 and ftype == TType.I64:
+                self.version = iprot.readI64()
+            elif fid == 2 and ftype == TType.STRING:
+                self.value = iprot.readString()
+            elif fid == 3 and ftype == TType.STRING:
+                self.originatorId = iprot.readString().decode()
+            elif fid == 4 and ftype == TType.I64:
+                self.ttl = iprot.readI64()
+            elif fid == 5 and ftype == TType.I64:
+                self.ttlVersion = iprot.readI64()
+            elif fid == 6 and ftype == TType.I64:
+                self.hash = iprot.readI64()
+            else:
+                iprot.skip(ftype)
+            iprot.readFieldEnd()
+        iprot.readStructEnd()
+
+    def write(self, oprot):
+        oprot.writeStructBegin("Value")
+        if self.version is not None:
+            oprot.writeFieldBegin("version", TType.I64, 1)
+            oprot.writeI64(self.version)
+            oprot.writeFieldEnd()
+        if self.value is not None:
+            oprot.writeFieldBegin("value", TType.STRING, 2)
+            oprot.writeString(self.value)
+            oprot.writeFieldEnd()
+        if self.originatorId is not None:
+            oprot.writeFieldBegin("originatorId", TType.STRING, 3)
+            oprot.writeString(self.originatorId)
+            oprot.writeFieldEnd()
+        if self.ttl is not None:
+            oprot.writeFieldBegin("ttl", TType.I64, 4)
+            oprot.writeI64(self.ttl)
+            oprot.writeFieldEnd()
+        if self.ttlVersion is not None:
+            oprot.writeFieldBegin("ttlVersion", TType.I64, 5)
+            oprot.writeI64(self.ttlVersion)
+            oprot.writeFieldEnd()
+        if self.hash is not None:
+            oprot.writeFieldBegin("hash", TType.I64, 6)
+            oprot.writeI64(self.hash)
+            oprot.writeFieldEnd()
+        oprot.writeFieldStop()
+        oprot.writeStructEnd()
+
+
+class KeySetParams_:
+    """openr.thrift.KeySetParams — 2 keyVals, 3 solicitResponse,
+    5 nodeIds, 6 floodRootId, 7 timestamp_ms."""
+
+    def __init__(self, keyVals=None, solicitResponse=True, nodeIds=None,
+                 floodRootId=None, timestamp_ms=None):
+        self.keyVals = keyVals
+        self.solicitResponse = solicitResponse
+        self.nodeIds = nodeIds
+        self.floodRootId = floodRootId
+        self.timestamp_ms = timestamp_ms
+
+    def write(self, oprot):
+        oprot.writeStructBegin("KeySetParams")
+        if self.keyVals is not None:
+            oprot.writeFieldBegin("keyVals", TType.MAP, 2)
+            oprot.writeMapBegin(TType.STRING, TType.STRUCT,
+                                len(self.keyVals))
+            for k, v in self.keyVals.items():
+                oprot.writeString(k)
+                v.write(oprot)
+            oprot.writeMapEnd()
+            oprot.writeFieldEnd()
+        if self.solicitResponse is not None:
+            oprot.writeFieldBegin("solicitResponse", TType.BOOL, 3)
+            oprot.writeBool(self.solicitResponse)
+            oprot.writeFieldEnd()
+        if self.nodeIds is not None:
+            oprot.writeFieldBegin("nodeIds", TType.LIST, 5)
+            oprot.writeListBegin(TType.STRING, len(self.nodeIds))
+            for n in self.nodeIds:
+                oprot.writeString(n)
+            oprot.writeListEnd()
+            oprot.writeFieldEnd()
+        oprot.writeFieldStop()
+        oprot.writeStructEnd()
+
+
+class Publication_:
+    """openr.thrift.Publication — 2 keyVals, 3 expiredKeys, 4 nodeIds,
+    7 area."""
+
+    def __init__(self):
+        self.keyVals = {}
+        self.expiredKeys = []
+        self.nodeIds = None
+        self.area = None
+
+    def read(self, iprot):
+        iprot.readStructBegin()
+        while True:
+            _fname, ftype, fid = iprot.readFieldBegin()
+            if ftype == TType.STOP:
+                break
+            if fid == 2 and ftype == TType.MAP:
+                _kt, _vt, size = iprot.readMapBegin()
+                for _ in range(size):
+                    k = iprot.readString().decode()
+                    v = Value_()
+                    v.read(iprot)
+                    self.keyVals[k] = v
+                iprot.readMapEnd()
+            elif fid == 3 and ftype == TType.LIST:
+                _et, size = iprot.readListBegin()
+                self.expiredKeys = [
+                    iprot.readString().decode() for _ in range(size)
+                ]
+                iprot.readListEnd()
+            elif fid == 4 and ftype == TType.LIST:
+                _et, size = iprot.readListBegin()
+                self.nodeIds = [
+                    iprot.readString().decode() for _ in range(size)
+                ]
+                iprot.readListEnd()
+            elif fid == 7 and ftype == TType.STRING:
+                self.area = iprot.readString().decode()
+            else:
+                iprot.skip(ftype)
+            iprot.readFieldEnd()
+        iprot.readStructEnd()
+
+
+class OpenrCtrlClient:
+    """Generated-client shape: send_*/recv_* pairs over one protocol."""
+
+    def __init__(self, iprot, oprot=None):
+        self._iprot = iprot
+        self._oprot = oprot or iprot
+        self._seqid = 0
+
+    # setKvStoreKeyVals(1: KeySetParams setParams, 2: string area)
+
+    def setKvStoreKeyVals(self, setParams, area):
+        self.send_setKvStoreKeyVals(setParams, area)
+        self.recv_setKvStoreKeyVals()
+
+    def send_setKvStoreKeyVals(self, setParams, area):
+        self._seqid += 1
+        o = self._oprot
+        o.writeMessageBegin("setKvStoreKeyVals", CALL, self._seqid)
+        o.writeStructBegin("setKvStoreKeyVals_args")
+        o.writeFieldBegin("setParams", TType.STRUCT, 1)
+        setParams.write(o)
+        o.writeFieldEnd()
+        o.writeFieldBegin("area", TType.STRING, 2)
+        o.writeString(area)
+        o.writeFieldEnd()
+        o.writeFieldStop()
+        o.writeStructEnd()
+        o.writeMessageEnd()
+        o.trans.flush()
+
+    def recv_setKvStoreKeyVals(self):
+        self._recv_void("setKvStoreKeyVals")
+
+    # getKvStoreKeyVals(1: list<string> filterKeys) -> Publication
+
+    def getKvStoreKeyVals(self, filterKeys):
+        self.send_getKvStoreKeyVals(filterKeys)
+        return self.recv_getKvStoreKeyVals()
+
+    def send_getKvStoreKeyVals(self, filterKeys):
+        self._seqid += 1
+        o = self._oprot
+        o.writeMessageBegin("getKvStoreKeyVals", CALL, self._seqid)
+        o.writeStructBegin("getKvStoreKeyVals_args")
+        o.writeFieldBegin("filterKeys", TType.LIST, 1)
+        o.writeListBegin(TType.STRING, len(filterKeys))
+        for k in filterKeys:
+            o.writeString(k)
+        o.writeListEnd()
+        o.writeFieldEnd()
+        o.writeFieldStop()
+        o.writeStructEnd()
+        o.writeMessageEnd()
+        o.trans.flush()
+
+    def recv_getKvStoreKeyVals(self):
+        i = self._iprot
+        _name, mtype, seqid = i.readMessageBegin()
+        assert seqid == self._seqid, "seqid mismatch"
+        if mtype == EXCEPTION:
+            x = TApplicationException()
+            x.read(i)
+            i.readMessageEnd()
+            raise x
+        success = None
+        i.readStructBegin()
+        while True:
+            _fname, ftype, fid = i.readFieldBegin()
+            if ftype == TType.STOP:
+                break
+            if fid == 0 and ftype == TType.STRUCT:
+                success = Publication_()
+                success.read(i)
+            else:
+                i.skip(ftype)
+            i.readFieldEnd()
+        i.readStructEnd()
+        i.readMessageEnd()
+        if success is None:
+            raise TApplicationException(
+                message="getKvStoreKeyVals failed: unknown result"
+            )
+        return success
+
+    # a method the server does not implement (exception-path probe)
+
+    def getUnsupportedThing(self):
+        self._seqid += 1
+        o = self._oprot
+        o.writeMessageBegin("getUnsupportedThing", CALL, self._seqid)
+        o.writeStructBegin("getUnsupportedThing_args")
+        o.writeFieldStop()
+        o.writeStructEnd()
+        o.writeMessageEnd()
+        o.trans.flush()
+        self._recv_void("getUnsupportedThing")
+
+    def _recv_void(self, name):
+        i = self._iprot
+        _name, mtype, seqid = i.readMessageBegin()
+        assert seqid == self._seqid, "seqid mismatch"
+        if mtype == EXCEPTION:
+            x = TApplicationException()
+            x.read(i)
+            i.readMessageEnd()
+            raise x
+        i.skip(TType.STRUCT)  # empty/void result struct
+        i.readMessageEnd()
+
+
+# ---------------------------------------------------------------------------
+# The test: vendored client above, openr_tpu only on the SERVER side
+# ---------------------------------------------------------------------------
+
+
+class TestGeneratedClientInterop:
+    @pytest.fixture
+    def shim(self):
+        from openr_tpu.interop.shim import ThriftBinaryShim
+        from openr_tpu.kvstore import InProcessTransport
+        from openr_tpu.main import OpenrDaemon
+        from openr_tpu.spark import MockIoProvider
+        from tests.test_system import make_config
+
+        fabric = MockIoProvider()
+        daemon = OpenrDaemon(
+            make_config("interopd", ctrl_port=0),
+            io_provider=fabric.endpoint("interopd"),
+            kvstore_transport=InProcessTransport().bind("interopd"),
+        )
+        daemon.start()
+        srv = ThriftBinaryShim(daemon.kvstore, port=0, node_name="interopd")
+        srv.run()
+        yield daemon, srv
+        srv.stop()
+        srv.wait_until_stopped(5)
+        daemon.stop()
+
+    def _client(self, port):
+        transport = TFramedTransport(TSocket("::1", port))
+        protocol = TBinaryProtocol(transport)
+        transport.open()
+        return transport, OpenrCtrlClient(protocol)
+
+    def test_set_then_get_roundtrip(self, shim):
+        daemon, srv = shim
+        transport, client = self._client(srv.port)
+        try:
+            client.setKvStoreKeyVals(
+                KeySetParams_(
+                    keyVals={
+                        "interop:gen": Value_(
+                            version=7,
+                            originatorId="thrift-client",
+                            value=b"generated-bytes",
+                            ttl=-1,
+                        )
+                    },
+                ),
+                "0",
+            )
+            # server side observed the write through its own store API
+            pub = daemon.kvstore.get_key_vals("0", ["interop:gen"])
+            assert pub.key_vals["interop:gen"].value == b"generated-bytes"
+
+            # and the generated client parses the Publication reply
+            out = client.getKvStoreKeyVals(["interop:gen"])
+            got = out.keyVals["interop:gen"]
+            assert got.version == 7
+            assert got.originatorId == "thrift-client"
+            assert got.value == b"generated-bytes"
+            assert got.ttl == -1
+            assert out.area == "0"
+        finally:
+            transport.close()
+
+    def test_get_missing_key_is_empty_publication(self, shim):
+        _daemon, srv = shim
+        transport, client = self._client(srv.port)
+        try:
+            out = client.getKvStoreKeyVals(["interop:no-such-key"])
+            assert out.keyVals == {}
+        finally:
+            transport.close()
+
+    def test_unknown_method_raises_application_exception(self, shim):
+        _daemon, srv = shim
+        transport, client = self._client(srv.port)
+        try:
+            with pytest.raises(TApplicationException):
+                client.getUnsupportedThing()
+        finally:
+            transport.close()
