@@ -958,6 +958,178 @@ def bench_flap_storm_wan100k(
     }
 
 
+def bench_ocs_rewire_wan100k(
+    n: int = 100_000,
+    rounds: int = 16,
+    swaps_per_round: int = 4,
+    seed: int = 13,
+) -> dict:
+    """OCS reconfiguration economics at WAN scale (round-11 tentpole):
+    rolling optical-circuit swaps against ONE resident graph through the
+    CSR slot freelist + engine rewire rung.  The headline is the byte
+    asymmetry — a bounded rewire stages a handful of masked-write rows
+    (KBs) where a restage re-uploads the whole edge set (MBs) — plus
+    rewire_us per dispatch.  full_restages must stay 1 (the initial
+    upload): every circuit swap rides the rewire rung or the row fails.
+
+    The topology mirrors OcsController's chorded WAN ring (ring +-1/+-2
+    under deterministic asymmetric metrics, one chord per node) but at
+    wan100k node count, driven through the real LinkState -> CsrTopology
+    refresh path; only the swap endpoints' adjacency databases are
+    re-pushed per round (LinkState preserves Link identity for untouched
+    adjacencies).  Chord picks are rejection-sampled — the controller's
+    exhaustive candidate scan is O(n^2) and only meant for test scale.
+
+    Honors OPENR_BENCH_BUDGET_S: sheds remaining rounds (and the final
+    cold bit-exact sweep) when the global wall budget runs low, and says
+    so in the row."""
+    import random
+
+    from openr_tpu.chaos.ocs import _CHORD_DEG_CAP, OcsController
+    from openr_tpu.decision.csr import CsrTopology
+    from openr_tpu.device.engine import DeviceResidencyEngine
+
+    ctl = OcsController(seed=seed, n=n, rounds=rounds, fault_round=-1)
+    rng = random.Random(seed)
+    chords = ctl._initial_chords()
+    deg = {i: 1 for i in range(n)}  # perfect matching: one chord each
+
+    t0 = time.perf_counter()
+    ls = ctl._build_ls(chords, {})
+    ls_build_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    csr = CsrTopology.from_link_state(ls)
+    csr_build_s = time.perf_counter() - t0
+
+    engine = DeviceResidencyEngine()
+    t0 = time.perf_counter()
+    engine.sync(csr)  # the one legitimate full staging
+    stage_s = time.perf_counter() - t0
+    restage_bytes = engine.get_counters()["device.engine.bytes_staged"]
+
+    def pick_chord():
+        # rejection-sample a fresh capacity-bounded non-ring chord
+        while True:
+            a, b = rng.randrange(n), rng.randrange(n)
+            if a == b:
+                continue
+            a, b = min(a, b), max(a, b)
+            d = b - a
+            if d in (1, 2) or n - d in (1, 2):
+                continue  # ring +-1/+-2 edge
+            if (a, b) in chords:
+                continue
+            if (
+                deg.get(a, 0) >= _CHORD_DEG_CAP
+                or deg.get(b, 0) >= _CHORD_DEG_CAP
+            ):
+                continue
+            return (a, b)
+
+    def push_nodes(touched):
+        for i in sorted(touched):
+            ls.update_adjacency_database(ctl._node_db(i, chords, {}))
+
+    shed_note = None
+    round_ms = []
+    done_rounds = 0
+    for _r in range(rounds):
+        if _budget_left() < 120:
+            shed_note = (
+                f"budget: shed {rounds - done_rounds} of {rounds} rounds"
+            )
+            break
+        touched = set()
+        for _ in range(swaps_per_round):
+            victim = rng.choice(sorted(chords))
+            chords.discard(victim)
+            for v in victim:
+                deg[v] -= 1
+            fresh = pick_chord()
+            chords.add(fresh)
+            for v in fresh:
+                deg[v] += 1
+            touched.update(victim)
+            touched.update(fresh)
+        push_nodes(touched)
+        t0 = time.perf_counter()
+        rewired = csr.refresh(ls)
+        assert rewired, "bounded swap fell off the rewire path"
+        engine.sync(csr)
+        round_ms.append((time.perf_counter() - t0) * 1e3)
+        done_rounds += 1
+
+    c = engine.get_counters()
+    assert c["device.engine.full_restages"] == 1, c
+    assert c["device.engine.rewire_fallbacks"] == 0, c
+    assert c["device.engine.rewire_dispatches"] == done_rounds, c
+    rewire_bytes = c["device.engine.rewire_bytes_staged"]
+    per_rewire = rewire_bytes / max(done_rounds, 1)
+
+    # acceptance spot-check: the incrementally-rewired resident must be
+    # bit-exact vs a cold rebuild+restage of the final topology
+    exact = None
+    if _budget_left() > 180 and done_rounds:
+        names = ls.node_names
+        sources = [names[(seed * 977 + k * 40503) % n] for k in range(3)]
+        got = engine.spf_results(csr, sources)
+        cold = DeviceResidencyEngine()
+        expect = cold.spf_results(CsrTopology.from_link_state(ls), sources)
+
+        def view(result):
+            return {
+                k: (v.metric, frozenset(v.next_hops))
+                for k, v in result.items()
+            }
+
+        exact = all(view(got[s]) == view(expect[s]) for s in sources)
+        assert exact, "rewired resident diverged from cold rebuild"
+    else:
+        shed_note = (shed_note or "") + "; budget: skipped cold sweep"
+
+    return {
+        "topology": f"wan{n // 1000}k-ocs-ring",
+        "n_nodes": n,
+        "rounds": done_rounds,
+        "links_swapped": done_rounds * swaps_per_round,
+        "scenario": (
+            f"rolling OCS circuit swaps, {swaps_per_round} chords "
+            "retired+programmed per round, one rewire dispatch per round"
+        ),
+        "rewire_dispatches": c["device.engine.rewire_dispatches"],
+        "rewire_slots": c["device.engine.rewire_slots"],
+        "rewire_rows": c["device.engine.rewire_rows"],
+        "bytes_per_rewire": round(per_rewire),
+        "full_restage_bytes": restage_bytes,
+        "restage_vs_rewire_bytes": (
+            round(restage_bytes / per_rewire, 1) if per_rewire else None
+        ),
+        "rewire_us_per_dispatch": round(
+            c["device.engine.rewire_us"] / max(done_rounds, 1), 1
+        ),
+        "round_ms_p50": round(_pctl(round_ms, 50), 2) if round_ms else None,
+        "round_ms_p95": round(_pctl(round_ms, 95), 2) if round_ms else None,
+        "full_restages": c["device.engine.full_restages"],
+        "rewire_fallbacks": c["device.engine.rewire_fallbacks"],
+        "initial_stage_s": round(stage_s, 2),
+        "ls_build_s": round(ls_build_s, 1),
+        "csr_build_s": round(csr_build_s, 1),
+        "cold_sweep_exact": exact,
+        "bytes_moved_est": None,
+        "achieved_bw_frac": None,
+        "note": (
+            "restage_vs_rewire_bytes is the headline: H2D bytes a full "
+            "re-upload costs per byte the masked-write rewire rung "
+            "stages for one bounded circuit swap.  round_ms includes "
+            "the host-side LinkState->CSR refresh (identity diff + slot "
+            "freelist patch), not just device time; rewire_us is the "
+            "engine-side staging alone."
+            + (f"  {shed_note}" if shed_note else "")
+        ),
+    }
+
+
 def bench_ksp_dual_metric_wan100k(topo, n_dests: int = 8) -> dict:
     """BASELINE config #3: dual-metric (IGP + TE) KSP at 100k nodes.
     Round-5 formulation: base SPF, ON-DEVICE path trace, and the masked
@@ -2045,6 +2217,9 @@ DEVICE_ROWS = {
     "fleet_warm_rebuild_wan100k": lambda t: bench_fleet_warm_wan100k(t.wan),
     # round-8 incremental delta dataflow: 1k-event storm -> 8 dispatches
     "flap_storm_wan100k": lambda t: bench_flap_storm_wan100k(t.wan),
+    # round-11 OCS circuit swaps: slot-freelist rewires vs full restage
+    # byte economics on one resident graph (builds its own LinkState)
+    "ocs_rewire_wan100k": lambda t: bench_ocs_rewire_wan100k(),
     # BASELINE config #3: dual-metric KSP at 100k (r3 next #6)
     "ksp_dual_metric_wan100k": lambda t: bench_ksp_dual_metric_wan100k(
         t.wan

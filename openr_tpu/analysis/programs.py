@@ -322,6 +322,35 @@ def _drive_engine(state: dict) -> None:
     state["engines"] = engines
 
 
+def _drive_rewire(state: dict) -> None:
+    """Edge-set rewire rung: retire a ring link and then re-add it so the
+    CSR slot freelist recycles the retired slots and the engine's
+    masked-ROW writers (`_masked_write_rows_i32` / `_masked_write_rows_bool`
+    for the changed ELL rows, plus the element writers for edge columns)
+    record production arg shapes.  The asserts keep the driver honest: a
+    demotion to restage would leave the row-writer roots spec-less and
+    fail program-coverage with a much less actionable finding."""
+    from ..decision.csr import CsrTopology
+    from ..device.engine import DeviceResidencyEngine
+
+    ls = _ring_link_state()
+    csr = CsrTopology.from_link_state(ls)
+    engine = DeviceResidencyEngine()
+    engine.spf_results(csr, ["r000"])
+    # link DOWN: bidirectional adjacency broken -> edge slots retire
+    _update_ring_node(ls, 20, drop=1)
+    assert csr.refresh(ls), "ring link drop must ride the rewire path"
+    engine.spf_results(csr, ["r001"])
+    # link back UP: the freelist hands the retired slots back
+    _update_ring_node(ls, 20)
+    assert csr.refresh(ls), "ring link re-add must ride the rewire path"
+    engine.spf_results(csr, ["r002"])
+    c = engine.get_counters()
+    assert c["device.engine.full_restages"] == 1, c
+    assert c["device.engine.rewires"] == 2, c
+    assert c["device.engine.rewire_rows"] > 0, c
+
+
 def _drive_fleet_ring(state: dict) -> None:
     """Fleet product on the banded ring: cold, warm-improve and warm-down
     rebuilds (the three reduced_all_sources entry modes)."""
@@ -614,6 +643,7 @@ def _drive_te(state: dict) -> None:
 
 DRIVERS: tuple[tuple[str, Callable[[dict], None]], ...] = (
     ("engine", _drive_engine),
+    ("rewire", _drive_rewire),
     ("fleet_ring", _drive_fleet_ring),
     ("delta", _drive_delta),
     ("blocked", _drive_blocked),

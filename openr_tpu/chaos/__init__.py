@@ -15,6 +15,7 @@ from .chaos import (
     LinkFaultProfile,
 )
 from .flapstorm import FlapStormResult, FlapStormScenario
+from .ocs import OcsController, OcsRewireResult
 from .overload import LoadReport, OpenLoopLoadGen
 from .scenario import ChaosScenario, fib_unicast_routes, oracle_route_dbs
 
@@ -29,6 +30,8 @@ __all__ = [
     "KvChaosInjector",
     "LinkFaultProfile",
     "LoadReport",
+    "OcsController",
+    "OcsRewireResult",
     "OpenLoopLoadGen",
     "fib_unicast_routes",
     "oracle_route_dbs",

@@ -33,18 +33,31 @@ def _next_pow2(n: int, floor: int = 8) -> int:
 
 
 def _build_out_slots(
-    edge_src: np.ndarray, edge_dst: np.ndarray, n_edges: int
+    edge_src: np.ndarray,
+    edge_dst: np.ndarray,
+    n_edges: int,
+    live: Optional[np.ndarray] = None,
 ) -> tuple[np.ndarray, int]:
     """out_slot[e] = rank of edge e's dst among src(e)'s sorted unique
     out-neighbors (parallel links share the slot); -1 for padding.
     Node ids are assigned in sorted-name order, so id rank == the
-    reference's name-sorted neighbor ordering.  Vectorized numpy."""
+    reference's name-sorted neighbor ordering.  Vectorized numpy.
+
+    `live` (the freelist's per-slot mask) excludes retired edge slots
+    inside [:n_edges]: dead slots rank as padding (-1), never as
+    out-neighbors of the padding node."""
     e_cap = len(edge_src)
     out_slot = np.full(e_cap, -1, dtype=np.int32)
     if n_edges == 0:
         return out_slot, 0
-    src = edge_src[:n_edges].astype(np.int64)
-    dst = edge_dst[:n_edges].astype(np.int64)
+    if live is None:
+        ids = np.arange(n_edges, dtype=np.int64)
+    else:
+        ids = np.flatnonzero(live[:n_edges]).astype(np.int64)
+        if ids.size == 0:
+            return out_slot, 0
+    src = edge_src[ids].astype(np.int64)
+    dst = edge_dst[ids].astype(np.int64)
     order = np.lexsort((dst, src))
     s_o, d_o = src[order], dst[order]
     new_grp = np.r_[True, s_o[1:] != s_o[:-1]]
@@ -53,8 +66,36 @@ def _build_out_slots(
     grp_id = np.cumsum(new_grp) - 1
     first_rank = nbr_rank[new_grp]  # [n_groups]
     slots = (nbr_rank - first_rank[grp_id]).astype(np.int32)
-    out_slot[order] = slots
+    out_slot[ids[order]] = slots
     return out_slot, int(slots.max()) + 1
+
+
+@dataclass
+class RewireDelta:
+    """One bounded in-place edge-set change (an OCS rewire) applied by
+    CsrTopology._try_rewire.  Everything the device-residency engine
+    needs to patch its mirror with masked writes instead of a restage:
+    the rewritten edge-array slots (post-rewire values), the out_slot
+    entries whose rank moved, and the full post-rewire contents of every
+    re-encoded ELL destination row."""
+
+    seq: int  # csr.rewire_seq after this rewire (contiguous chain)
+    version: int  # LinkState.version the rewire landed at
+    slots: np.ndarray  # [M] int32 — edge slots rewritten in place
+    src: np.ndarray  # [M] int32
+    dst: np.ndarray  # [M] int32
+    metric: np.ndarray  # [M] int32
+    up: np.ndarray  # [M] bool
+    live: np.ndarray  # [M] bool
+    out_idx: np.ndarray  # int32 — out_slot entries whose rank changed
+    out_val: np.ndarray  # int32
+    # [(bucket index, local row, nbr, w, eid, ok, transit_ok)] — full
+    # post-rewire row contents in the ELL bucket layout
+    ell_rows: list
+    n_edges: int  # post-rewire high-water edge count
+    max_out_slots: int  # post-rewire first-hop slot ceiling
+    links_added: int
+    links_removed: int
 
 
 @dataclass
@@ -72,10 +113,22 @@ class CsrTopology:
     edge_metric: np.ndarray  # [E_cap] int32
     edge_up: np.ndarray  # [E_cap] bool
     node_overloaded: np.ndarray  # [N_cap] bool
-    # directed edge id -> (Link, from_node_name); len == real edge count
-    edge_links: list[tuple[Link, str]]
+    # directed edge id -> (Link, from_node_name), or None for a retired
+    # slot; len == n_edges (the high-water edge count)
+    edge_links: list[Optional[tuple[Link, str]]]
     n_edges: int = 0
     version: int = -1  # LinkState.version this mirror was built from
+    # edge-slot freelist (OCS rewires): live mask over [:n_edges] — a
+    # retired slot keeps its position (styled like padding: src = dst =
+    # pad node, up False) so the edge arrays, ELL tables and compiled
+    # kernels all survive a bounded edge-set change in place
+    edge_live: Optional[np.ndarray] = None  # [E_cap] bool
+    n_live: int = 0  # live directed edges (2 x live links)
+    rewire_seq: int = 0  # bumped once per applied in-place rewire
+    _free_slots: list = field(default_factory=list)
+    # bounded chain of RewireDeltas for engine consumption; a resident
+    # that fell behind the window restages (engine._rewire_sync)
+    _rewire_log: list = field(default_factory=list)
     # degree-bucketed ELL mirror (ops.sssp.EllGraph) — the production
     # relaxation tables; rebuilt with the edge arrays
     ell: object = None
@@ -168,6 +221,8 @@ class CsrTopology:
         node_overloaded = np.zeros(n_cap, dtype=bool)
         for name, i in node_id.items():
             node_overloaded[i] = ls.is_node_overloaded(name)
+        edge_live = np.zeros(e_cap, dtype=bool)
+        edge_live[:e] = True
 
         from ..ops.banded import build_banded
         from ..ops.sssp import build_ell
@@ -191,6 +246,8 @@ class CsrTopology:
             node_overloaded=node_overloaded,
             edge_links=[(r[4], r[5]) for r in rows],
             n_edges=e,
+            edge_live=edge_live,
+            n_live=e,
             version=ls.version,
             ell=ell,
             banded=banded,
@@ -198,32 +255,52 @@ class CsrTopology:
             max_out_slots=max_out_slots,
         )
 
+    # directed-edge slots one rewire may touch before the masked-write
+    # delta rivals a restage and the full rebuild is the cheaper path
+    REWIRE_MAX_SLOTS = 256
+    # RewireDeltas retained for engine catch-up; a resident more than
+    # this many rewires behind restages instead of replaying
+    REWIRE_LOG_DEPTH = 32
+
     def refresh(self, ls: LinkState) -> bool:
         """Bring the mirror to `ls.version`, in place when possible.
 
-        Returns True when only link/node ATTRIBUTES changed (metric, up,
-        overload): the edge arrays are updated in place and neither the
-        ELL tables nor compiled kernels are touched — the relaxation reads
-        edge_up / node_overloaded at call time (SURVEY §7 stage 2's
-        incremental device update).  On edge-set or node-set changes the
-        mirror is rebuilt; capacities are re-used when the new topology
-        still fits, so kernel shapes — and therefore XLA compilations —
-        are stable until a capacity bucket overflows."""
+        Returns True when the mirror stayed in place: either only
+        link/node ATTRIBUTES changed (metric, up, overload) — the edge
+        arrays are updated in place and neither the ELL tables nor
+        compiled kernels are touched, because the relaxation reads
+        edge_up / node_overloaded at call time — or the edge-set change
+        was a BOUNDED rewire (links added/removed/swapped within
+        edge_capacity): retired slots are recycled through the edge-slot
+        freelist, out_slot is re-ranked and only the affected ELL
+        destination rows are re-encoded (_try_rewire), all against the
+        same array/ELL objects, so device residency survives too.
+
+        Returns False when the mirror was REBUILT: node-set changes,
+        capacity overflow, or an oversized rewire.  Capacities are
+        re-used when the new topology still fits, so kernel shapes — and
+        therefore XLA compilations — are stable until a capacity bucket
+        overflows.  The rebuild path never errors on a rewire the
+        freelist could not absorb; it is the graceful fallback."""
         if ls.version == self.version:
             return True
         names = ls.node_names
         same_topology = names == self.node_names and len(
             ls.all_links
-        ) * 2 == self.n_edges
+        ) * 2 == self.n_live
         if same_topology:
             # identical link OBJECTS?  Identity, not set equality:
             # Link.__eq__ keys on (node, iface) pairs only, so a link that
             # was removed and re-added as a new object would compare equal
             # while our edge_links still points at the retired object
             # (whose metric/up state no longer updates).
-            current = {id(link) for link, _ in self.edge_links}
+            current = {
+                id(lp[0]) for lp in self.edge_links if lp is not None
+            }
             same_topology = current == {id(link) for link in ls.all_links}
         if not same_topology:
+            if self._try_rewire(ls):
+                return True
             hint = self._sweep_hint
             rebuilt = CsrTopology.from_link_state(
                 ls,
@@ -244,18 +321,205 @@ class CsrTopology:
             self._sweep_hint = hint
             return False
 
-        # attribute-only refresh: links are shared objects, re-read values
-        for e, (link, from_name) in enumerate(self.edge_links):
-            self.edge_metric[e] = link.metric_from_node(from_name)
-            self.edge_up[e] = link.is_up()
-        for name, i in self.node_id.items():
-            self.node_overloaded[i] = ls.is_node_overloaded(name)
+        self._refresh_attributes(ls)
         self.version = ls.version
         if self._runner is not None:
             # re-pin the refreshed values (a stale staged runner would
             # read pre-refresh state); one upload per topology change,
             # amortized over every later dispatch
             self._runner.stage()
+        return True
+
+    def _refresh_attributes(self, ls: LinkState) -> None:
+        """Re-read metric/up/overload from the shared link objects into
+        the arrays, in place (retired slots stay padding)."""
+        for e, lp in enumerate(self.edge_links):
+            if lp is None:
+                continue
+            link, from_name = lp
+            self.edge_metric[e] = link.metric_from_node(from_name)
+            self.edge_up[e] = link.is_up()
+        for name, i in self.node_id.items():
+            self.node_overloaded[i] = ls.is_node_overloaded(name)
+
+    def _try_rewire(self, ls: LinkState) -> bool:
+        """Bounded in-place edge-set change — the OCS slot freelist.
+
+        Retires the removed links' edge slots (styled as padding inside
+        [:n_edges]), re-points recycled/appended slots at the added
+        links, re-reads attributes, re-ranks out_slot and re-encodes
+        only the affected ELL destination rows — all against the SAME
+        numpy/ELL objects, so compiled kernels and device residency
+        (keyed on object identity) survive.  Appends a RewireDelta to
+        the bounded rewire log for the engine's masked-write rung.
+
+        Returns False — leaving the caller to take the full-rebuild
+        path, which never errors — on a node-set change, freelist +
+        tail-capacity exhaustion, an affected ELL row outgrowing its
+        bucket's K headroom, or an oversized delta.  A False return may
+        leave the arrays partially patched: the rebuild replaces every
+        field from `ls`, so no torn state survives it."""
+        if ls.node_names != self.node_names:
+            return False
+        cur_slots: dict[int, list[int]] = {}
+        cur_links: dict[int, Link] = {}
+        for e, lp in enumerate(self.edge_links):
+            if lp is None:
+                continue
+            cur_slots.setdefault(id(lp[0]), []).append(e)
+            cur_links[id(lp[0])] = lp[0]
+        new_links = {id(link): link for link in ls.all_links}
+        retiring = sorted(
+            s
+            for lid, slots in cur_slots.items()
+            if lid not in new_links
+            for s in slots
+        )
+        added = sorted(
+            link for lid, link in new_links.items() if lid not in cur_slots
+        )
+        if not retiring and not added:
+            return False  # count drift without identity drift: rebuild
+        pool = sorted(set(self._free_slots) | set(retiring))
+        tail = self.edge_capacity - self.n_edges
+        if 2 * len(added) > len(pool) + tail:
+            return False  # capacity overflow: rebuild (may grow buckets)
+        if len(retiring) + 2 * len(added) > self.REWIRE_MAX_SLOTS:
+            return False  # oversized delta: the restage is cheaper
+
+        pad_node = self.node_capacity - 1
+        touched: list[int] = []
+        affected_dst: set[int] = set()
+        for s in retiring:
+            affected_dst.add(int(self.edge_dst[s]))
+            self.edge_src[s] = pad_node
+            self.edge_dst[s] = pad_node
+            self.edge_metric[s] = 1
+            self.edge_up[s] = False
+            self.edge_live[s] = False
+            self.edge_links[s] = None
+            touched.append(s)
+        for link in added:
+            for u_name in (link.n1, link.n2):
+                v_name = link.other_node_name(u_name)
+                metric = link.metric_from_node(u_name)
+                assert metric >= 1, (
+                    "edge metrics must be >= 1 (distance-ordered DAG "
+                    "propagation and int32 distance math rely on "
+                    "positive metrics)"
+                )
+                if pool:
+                    s = pool.pop(0)
+                else:
+                    s = self.n_edges
+                    self.n_edges += 1
+                    self.edge_links.append(None)
+                self.edge_src[s] = self.node_id[u_name]
+                self.edge_dst[s] = self.node_id[v_name]
+                self.edge_metric[s] = metric
+                self.edge_up[s] = link.is_up()
+                self.edge_live[s] = True
+                self.edge_links[s] = (link, u_name)
+                affected_dst.add(int(self.edge_dst[s]))
+                touched.append(s)
+        self._free_slots = pool
+        self.n_live = int(self.edge_live[: self.n_edges].sum())
+
+        # attribute flaps batched into the same version ride along, so
+        # the delta's per-slot values and the ELL snapshots below are
+        # read from post-refresh state
+        self._refresh_attributes(ls)
+
+        # re-encode the affected ELL destination rows in place (same
+        # bucket arrays — residency identity survives); the relabeling
+        # (new_of_old) is frozen at build time, so a node's row never
+        # moves — only its contents change
+        new_of_old = np.asarray(self.ell.new_of_old)
+        row_lo = []
+        lo = 0
+        for b in self.ell.buckets:
+            row_lo.append(lo)
+            lo += b.nbr.shape[0]
+        dst_v = self.edge_dst[: self.n_edges]
+        live_v = self.edge_live[: self.n_edges]
+        rows_patch = []
+        for d in sorted(affected_dst):
+            eids = np.flatnonzero((dst_v == d) & live_v)
+            r = int(new_of_old[d])
+            b_idx = bisect.bisect_right(row_lo, r) - 1
+            bkt = self.ell.buckets[b_idx]
+            k_cap = bkt.nbr.shape[1]
+            if len(eids) > k_cap:
+                return False  # in-degree outgrew the row's K headroom
+            row_nbr = np.zeros(k_cap, dtype=np.int32)
+            row_w = np.ones(k_cap, dtype=np.int32)
+            row_eid = np.full(k_cap, -1, dtype=np.int32)
+            row_ok = np.zeros(k_cap, dtype=bool)
+            row_tok = np.zeros(k_cap, dtype=bool)
+            k = len(eids)
+            if k:
+                row_nbr[:k] = new_of_old[self.edge_src[eids]]
+                row_w[:k] = self.edge_metric[eids]
+                row_eid[:k] = eids.astype(np.int32)
+                row_ok[:k] = self.edge_up[eids]
+                row_tok[:k] = ~self.node_overloaded[self.edge_src[eids]]
+            rows_patch.append(
+                (b_idx, r - row_lo[b_idx], row_nbr, row_w, row_eid,
+                 row_ok, row_tok)
+            )
+        # feasibility proven — apply the row patches in place
+        for b_idx, lr, rn, rw, re_, ro, rt in rows_patch:
+            bkt = self.ell.buckets[b_idx]
+            bkt.nbr[lr] = rn
+            bkt.w[lr] = rw
+            bkt.edge_id[lr] = re_
+            bkt.ok[lr] = ro
+            bkt.transit_ok[lr] = rt
+
+        new_out, new_max = _build_out_slots(
+            self.edge_src, self.edge_dst, self.n_edges, live=self.edge_live
+        )
+        out_changed = np.flatnonzero(new_out != self.out_slot).astype(
+            np.int32
+        )
+        self.out_slot[:] = new_out
+        self.max_out_slots = new_max
+
+        # band structure is host-only (SpfRunner): rebuild it from the
+        # live edges and let the runner re-materialize lazily
+        from ..ops.banded import build_banded
+
+        self.banded = build_banded(
+            self.edge_src, self.edge_dst, self.n_edges, self.n_nodes
+        )
+        self._runner = None
+
+        # a slot retired and recycled in the same rewire is touched
+        # twice; the delta reads final array state, so dedupe (the
+        # masked-write kernels require unique indices)
+        slots_v = np.asarray(sorted(set(touched)), dtype=np.int32)
+        self.rewire_seq += 1
+        self._rewire_log.append(
+            RewireDelta(
+                seq=self.rewire_seq,
+                version=ls.version,
+                slots=slots_v,
+                src=self.edge_src[slots_v].copy(),
+                dst=self.edge_dst[slots_v].copy(),
+                metric=self.edge_metric[slots_v].copy(),
+                up=self.edge_up[slots_v].copy(),
+                live=self.edge_live[slots_v].copy(),
+                out_idx=out_changed,
+                out_val=new_out[out_changed].copy(),
+                ell_rows=rows_patch,
+                n_edges=self.n_edges,
+                max_out_slots=new_max,
+                links_added=len(added),
+                links_removed=len(retiring) // 2,
+            )
+        )
+        del self._rewire_log[: -self.REWIRE_LOG_DEPTH]
+        self.version = ls.version
         return True
 
     # -- SPF execution ------------------------------------------------------
@@ -406,8 +670,10 @@ class CsrTopology:
         to their own instances)."""
         out: dict = {}
         for e in range(self.n_edges):
-            link, _ = self.edge_links[e]
-            out.setdefault(link, []).append(e)
+            lp = self.edge_links[e]
+            if lp is None:  # retired slot (edge freelist)
+                continue
+            out.setdefault(lp[0], []).append(e)
         return out
 
     def spf_from(
@@ -502,13 +768,18 @@ class CsrTopology:
     @property
     def _links_of(self) -> dict[str, list[Link]]:
         links: dict[str, list[Link]] = {}
-        for link, from_name in self.edge_links:
-            links.setdefault(from_name, []).append(link)
+        for lp in self.edge_links:
+            if lp is None:
+                continue
+            links.setdefault(lp[1], []).append(lp[0])
         return links
 
     @property
     def max_degree(self) -> int:
         deg: dict[str, set[str]] = {}
-        for link, from_name in self.edge_links:
+        for lp in self.edge_links:
+            if lp is None:
+                continue
+            link, from_name = lp
             deg.setdefault(from_name, set()).add(link.other_node_name(from_name))
         return max((len(v) for v in deg.values()), default=0)

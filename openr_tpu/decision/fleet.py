@@ -209,11 +209,19 @@ def _reverse_runner(csr, hint: Optional[int] = None):
     from ..ops.banded import SpfRunner, build_banded
     from ..ops.sssp import build_ell
 
-    e = csr.n_edges
-    src = csr.edge_dst[:e].copy()
-    dst = csr.edge_src[:e].copy()
-    met = csr.edge_metric[:e].copy()
-    up = csr.edge_up[:e].copy()
+    # retired freelist slots (csr rewires) are padding inside
+    # [:n_edges]; the reversed snapshot renumbers edges into its own
+    # dense space anyway, so compact them away here
+    live = getattr(csr, "edge_live", None)
+    if live is None:
+        ids = np.arange(csr.n_edges)
+    else:
+        ids = np.flatnonzero(live[: csr.n_edges])
+    e = len(ids)
+    src = csr.edge_dst[ids].copy()
+    dst = csr.edge_src[ids].copy()
+    met = csr.edge_metric[ids].copy()
+    up = csr.edge_up[ids].copy()
     order = np.lexsort((src, dst))
     pad_node = csr.node_capacity - 1
     edge_src = np.full(csr.edge_capacity, pad_node, dtype=np.int32)

@@ -33,7 +33,10 @@ def _pair_edge_ids(csr: CsrTopology) -> dict[tuple[str, str], list[int]]:
     """(sorted node pair) -> directed edge ids of every parallel link
     between them — one O(E) pass, O(1) per scenario-link lookup."""
     out: dict[tuple[str, str], list[int]] = {}
-    for e, (link, _from) in enumerate(csr.edge_links):
+    for e, pair in enumerate(csr.edge_links):
+        if pair is None:  # retired freelist slot
+            continue
+        link = pair[0]
         key = (link.n1, link.n2) if link.n1 <= link.n2 else (link.n2, link.n1)
         out.setdefault(key, []).append(e)
     return out

@@ -10,6 +10,7 @@ plane query never pays per-call staging or retracing.
 from .engine import (
     DeviceResidencyEngine,
     ENGINE_COUNTER_KEYS,
+    EpochMismatchError,
     S_BUCKETS,
 )
 from .sanitizer import EngineSanitizer, SanitizerViolation
@@ -18,6 +19,7 @@ __all__ = [
     "DeviceResidencyEngine",
     "ENGINE_COUNTER_KEYS",
     "EngineSanitizer",
+    "EpochMismatchError",
     "S_BUCKETS",
     "SanitizerViolation",
 ]

@@ -63,6 +63,13 @@ ENGINE_COUNTER_KEYS = (
     "device.engine.delta_bucket_hits",
     "device.engine.delta_bucket_misses",
     "device.engine.delta_overflow_fallbacks",
+    "device.engine.rewires",
+    "device.engine.rewire_dispatches",
+    "device.engine.rewire_slots",
+    "device.engine.rewire_rows",
+    "device.engine.rewire_bytes_staged",
+    "device.engine.rewire_us",
+    "device.engine.rewire_fallbacks",
 )
 
 # affected-column padding ladder for the delta rung: a frontier of
@@ -123,6 +130,24 @@ def _masked_write_bool(arr, idx, vals):
     return jnp.where(hit.any(axis=1), picked, arr)
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _masked_write_rows_i32(arr, row_idx, rows):
+    """arr[row_idx, :] = rows without a scatter.  `arr` is [N, K],
+    `row_idx` is [R] padded with -1 (never matches), `rows` is [R, K].
+    Same fast-dispatch discipline as the element masked writes — the
+    rewire rung patches whole re-encoded ELL destination rows."""
+    hit = jnp.arange(arr.shape[0], dtype=jnp.int32)[:, None] == row_idx[None, :]
+    picked = (hit[:, :, None] * rows[None, :, :]).sum(axis=1)
+    return jnp.where(hit.any(axis=1)[:, None], picked.astype(arr.dtype), arr)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _masked_write_rows_bool(arr, row_idx, rows):
+    hit = jnp.arange(arr.shape[0], dtype=jnp.int32)[:, None] == row_idx[None, :]
+    picked = (hit[:, :, None] & rows[None, :, :]).any(axis=1)
+    return jnp.where(hit.any(axis=1)[:, None], picked, arr)
+
+
 def _pad_updates(idx: np.ndarray, vals: np.ndarray, pad_val):
     """Pad (idx, vals) to a small power-of-two K so the masked-write
     programs bucket by update count instead of retracing per flap."""
@@ -134,6 +159,27 @@ def _pad_updates(idx: np.ndarray, vals: np.ndarray, pad_val):
         idx = np.concatenate([idx, np.full(pad, -1, dtype=np.int32)])
         vals = np.concatenate([vals, np.full(pad, pad_val, dtype=vals.dtype)])
     return idx, vals
+
+
+def _pad_rows(row_idx: np.ndarray, *row_arrays):
+    """Row-update analogue of `_pad_updates`: pad the [R] index vector
+    with -1 and each [R, K] payload with zero rows up to a small
+    power-of-two R so the row-write programs bucket by row count."""
+    k = 8
+    while k < len(row_idx):
+        k *= 2
+    pad = k - len(row_idx)
+    if pad:
+        row_idx = np.concatenate(
+            [row_idx, np.full(pad, -1, dtype=np.int32)]
+        )
+        row_arrays = tuple(
+            np.concatenate(
+                [a, np.zeros((pad,) + a.shape[1:], dtype=a.dtype)]
+            )
+            for a in row_arrays
+        )
+    return (row_idx,) + row_arrays
 
 
 def _forward_body(
@@ -221,6 +267,9 @@ class _Resident:
     shadow_up: np.ndarray = field(repr=False, default=None)
     shadow_overloaded: np.ndarray = field(repr=False, default=None)
     sweep_hint: int = 16
+    # last CsrTopology.rewire_seq applied to the device mirror; a gap
+    # against csr.rewire_seq routes sync() through the rewire rung
+    rewire_seq: int = 0
 
 
 class DeviceResidencyEngine:
@@ -298,16 +347,26 @@ class DeviceResidencyEngine:
         """Bring `csr`'s device residency to csr.version.
 
         Full restage only when the ELL object changed (topology
-        rebuild); attribute-only refreshes diff the host shadows and
-        apply masked writes on device."""
+        rebuild); bounded edge-set rewires replay the CsrTopology rewire
+        log through masked slot/row writes; attribute-only refreshes
+        diff the host shadows and apply masked writes on device."""
         if self.fault_hook is not None:
             self.fault_hook("sync")
         t0 = time.perf_counter()
         res = self._residents.get(id(csr))
         if res is None or res.ell_host is not csr.ell:
             res = self._restage(csr)
-        elif res.version != csr.version:
-            self._incremental(res, csr)
+        else:
+            if getattr(csr, "rewire_seq", 0) != res.rewire_seq:
+                try:
+                    self._rewire_sync(res, csr)
+                except Exception:
+                    # any rewire failure (log gap, fault injection, ...)
+                    # demotes to the restage rung — never an error
+                    self._bump("device.engine.rewire_fallbacks")
+                    res = self._restage(csr)
+            if res.version != csr.version:
+                self._incremental(res, csr)
         self._bump(
             "device.engine.stage_us",
             int((time.perf_counter() - t0) * 1e6),
@@ -342,6 +401,7 @@ class DeviceResidencyEngine:
             shadow_up=csr.edge_up.copy(),
             shadow_overloaded=csr.node_overloaded.copy(),
             sweep_hint=csr._sweep_hint,
+            rewire_seq=getattr(csr, "rewire_seq", 0),
         )
         self._residents[id(csr)] = res
         self._bump("device.engine.full_restages")
@@ -379,6 +439,118 @@ class DeviceResidencyEngine:
         self._bump("device.engine.incremental_updates")
         if staged:
             self._bump("device.engine.bytes_staged", staged)
+
+    def _rewire_sync(self, res: _Resident, csr) -> None:
+        """Replay the pending tail of csr's rewire log against the
+        resident: masked writes for the rewritten edge slots plus
+        donated row writes for every re-encoded ELL destination row.
+        Upload cost is O(touched slots + touched rows) — never the
+        graph, so a bounded OCS rewire keeps full_restages == 1.
+
+        Raises on any inconsistency (log gap after eviction, injected
+        fault); sync() demotes that to a restage."""
+        t0 = time.perf_counter()
+        if self.fault_hook is not None:
+            self.fault_hook("rewire")
+        pending = [d for d in csr._rewire_log if d.seq > res.rewire_seq]
+        if (
+            not pending
+            or pending[0].seq != res.rewire_seq + 1
+            or pending[-1].seq != csr.rewire_seq
+            or any(
+                b.seq != a.seq + 1 for a, b in zip(pending, pending[1:])
+            )
+        ):
+            raise RuntimeError(
+                f"rewire chain gap: resident at seq {res.rewire_seq}, "
+                f"log covers {[d.seq for d in pending]}"
+            )
+        staged = n_slots = n_rows = 0
+        for delta in pending:
+            staged += self._apply_rewire(res, delta)
+            n_slots += len(delta.slots)
+            n_rows += len(delta.ell_rows)
+            self._bump("device.engine.rewires")
+        res.rewire_seq = csr.rewire_seq
+        # the touched slots are current in the shadows now; when nothing
+        # else drifted the resident is fully at csr.version and the
+        # attribute-diff rung can be skipped outright
+        if (
+            np.array_equal(res.shadow_metric, csr.edge_metric)
+            and np.array_equal(res.shadow_up, csr.edge_up)
+            and np.array_equal(res.shadow_overloaded, csr.node_overloaded)
+        ):
+            res.version = csr.version
+        self._bump("device.engine.rewire_dispatches")
+        self._bump("device.engine.rewire_slots", n_slots)
+        self._bump("device.engine.rewire_rows", n_rows)
+        self._bump("device.engine.rewire_bytes_staged", staged)
+        self._bump("device.engine.bytes_staged", staged)
+        self._bump(
+            "device.engine.rewire_us",
+            int((time.perf_counter() - t0) * 1e6),
+        )
+
+    def _apply_rewire(self, res: _Resident, delta) -> int:
+        """Apply one RewireDelta to the resident mirror; returns bytes
+        uploaded.  Slot payloads ride the element masked writes, ELL
+        rows ride the donated row writes (grouped per bucket so each
+        [N_b, K_b] cell compiles once)."""
+        staged = 0
+        for attr, idx, vals, write, shadow in (
+            ("edge_src", delta.slots, delta.src, _masked_write_i32, None),
+            ("edge_dst", delta.slots, delta.dst, _masked_write_i32, None),
+            ("edge_metric", delta.slots, delta.metric, _masked_write_i32,
+             res.shadow_metric),
+            ("edge_up", delta.slots, delta.up, _masked_write_bool,
+             res.shadow_up),
+            ("out_slot", delta.out_idx, delta.out_val, _masked_write_i32,
+             None),
+        ):
+            if len(idx) == 0:
+                continue
+            pi, pv = _pad_updates(
+                idx.astype(np.int32), vals, pad_val=vals.dtype.type(0)
+            )
+            # explicit H2D staging — same transfer-guard discipline as
+            # the attribute rung
+            pi_dev, pv_dev = jax.device_put((pi, pv))
+            setattr(res, attr, write(getattr(res, attr), pi_dev, pv_dev))
+            staged += _nbytes(pi, pv)
+            if shadow is not None:
+                shadow[idx] = vals
+        by_bucket: dict[int, list] = {}
+        for row in delta.ell_rows:
+            by_bucket.setdefault(row[0], []).append(row)
+        if not by_bucket:
+            return staged
+        buckets = list(res.ell.buckets)
+        for b_idx, rows in by_bucket.items():
+            bkt = buckets[b_idx]
+            row_idx = np.asarray([r[1] for r in rows], dtype=np.int32)
+            nbr = np.stack([r[2] for r in rows])
+            w = np.stack([r[3] for r in rows])
+            eid = np.stack([r[4] for r in rows])
+            ok = np.stack([r[5] for r in rows])
+            tok = np.stack([r[6] for r in rows])
+            row_idx, nbr, w, eid, ok, tok = _pad_rows(
+                row_idx, nbr, w, eid, ok, tok
+            )
+            idx_dev, nbr_dev, w_dev, eid_dev, ok_dev, tok_dev = (
+                jax.device_put((row_idx, nbr, w, eid, ok, tok))
+            )
+            buckets[b_idx] = bkt._replace(
+                nbr=_masked_write_rows_i32(bkt.nbr, idx_dev, nbr_dev),
+                w=_masked_write_rows_i32(bkt.w, idx_dev, w_dev),
+                edge_id=_masked_write_rows_i32(bkt.edge_id, idx_dev, eid_dev),
+                ok=_masked_write_rows_bool(bkt.ok, idx_dev, ok_dev),
+                transit_ok=_masked_write_rows_bool(
+                    bkt.transit_ok, idx_dev, tok_dev
+                ),
+            )
+            staged += _nbytes(row_idx, nbr, w, eid, ok, tok)
+        res.ell = res.ell._replace(buckets=tuple(buckets))
+        return staged
 
     def drop(self, csr) -> None:
         """Forget `csr`'s residency (mirror retired)."""

@@ -72,17 +72,26 @@ def build_out_ell(
     out_slot: Optional[np.ndarray] = None,
 ) -> OutEll:
     """Vectorized out-edge table build.  `out_slot` (per-edge slot ids,
-    csr._build_out_slots layout) is recomputed here when not supplied."""
+    csr._build_out_slots layout) is recomputed here when not supplied.
+
+    Retired freelist slots (csr rewires) sit inside [:n_edges] styled as
+    padding — endpoints at the pad node >= n_nodes — and are dropped
+    here so they never index the [N]-sized tables."""
     src = np.asarray(edge_src[:n_edges], dtype=np.int64)
     dst = np.asarray(edge_dst[:n_edges], dtype=np.int64)
+    ids = np.flatnonzero((src < n_nodes) & (dst < n_nodes))
+    src, dst = src[ids], dst[ids]
     if out_slot is None:
         from ..decision.csr import _build_out_slots
 
+        live = np.zeros(n_edges, dtype=bool)
+        live[ids] = True
         out_slot, _ = _build_out_slots(
-            np.asarray(edge_src), np.asarray(edge_dst), n_edges
+            np.asarray(edge_src), np.asarray(edge_dst), n_edges, live=live
         )
+    e_slot = np.asarray(out_slot[:n_edges])[ids]
     deg = np.bincount(src, minlength=n_nodes)
-    k = int(deg.max()) if n_edges else 1
+    k = int(deg.max()) if ids.size else 1
     k_pad = 1
     while k_pad < max(k, 1):
         k_pad *= 2
@@ -95,9 +104,9 @@ def build_out_ell(
     eid = np.full((n_nodes, k_pad), -1, dtype=np.int32)
     slot = np.full((n_nodes, k_pad), -1, dtype=np.int32)
     nbr[s_sorted, pos] = dst[e_sorted].astype(np.int32)
-    eid[s_sorted, pos] = e_sorted.astype(np.int32)
-    slot[s_sorted, pos] = out_slot[:n_edges][e_sorted]
-    max_slots = int(out_slot[:n_edges].max()) + 1 if n_edges else 1
+    eid[s_sorted, pos] = ids[e_sorted].astype(np.int32)
+    slot[s_sorted, pos] = e_slot[e_sorted]
+    max_slots = int(e_slot.max()) + 1 if ids.size else 1
     return OutEll(
         nbr=jnp.asarray(nbr),
         eid=jnp.asarray(eid),

@@ -132,6 +132,13 @@ def build_banded(
         return None
     src = edge_src[:n_edges].astype(np.int64)
     dst = edge_dst[:n_edges].astype(np.int64)
+    # retired freelist slots (csr rewires) sit inside [:n_edges] styled
+    # as padding (endpoints at the pad node >= n_nodes); they are not
+    # edges of the graph and must not index the [N]-sized tables
+    ids = np.flatnonzero((src < n_nodes) & (dst < n_nodes))
+    if ids.size == 0:
+        return None
+    src, dst = src[ids], dst[ids]
     off = (dst - src) % n_nodes
     vals, counts = np.unique(off, return_counts=True)
     thresh = max(int(n_nodes * min_band_frac), 32)
@@ -157,7 +164,7 @@ def build_banded(
     order = np.lexsort((eids, cols, rows))
     r_o, c_o, e_o = rows[order], cols[order], eids[order]
     dup = np.r_[False, (r_o[1:] == r_o[:-1]) & (c_o[1:] == c_o[:-1])]
-    band_eid[r_o[~dup], c_o[~dup]] = e_o[~dup]
+    band_eid[r_o[~dup], c_o[~dup]] = ids[e_o[~dup]].astype(np.int32)
     demoted = e_o[dup]
     is_band[demoted] = False
 
@@ -171,8 +178,8 @@ def build_banded(
         return None
     # band edges must be worth the residual-table inefficiency: require
     # bands to cover enough edges that the uniform-K residual is smaller
-    # than the work the bucketed ELL would do (~n_edges slots)
-    if n_nodes * k_pad > n_edges:
+    # than the work the bucketed ELL would do (~live edge slots)
+    if n_nodes * k_pad > len(src):
         return None
     resid_nbr = np.zeros((n_nodes, k_pad), dtype=np.int32)
     resid_eid = np.full((n_nodes, k_pad), -1, dtype=np.int32)
@@ -184,7 +191,7 @@ def build_banded(
         starts = np.searchsorted(d_sorted, np.arange(n_nodes))
         slot = np.arange(r_sorted.size) - starts[d_sorted]
         resid_nbr[d_sorted, slot] = src[r_sorted].astype(np.int32)
-        resid_eid[d_sorted, slot] = r_sorted.astype(np.int32)
+        resid_eid[d_sorted, slot] = ids[r_sorted].astype(np.int32)
         # chord-bucketed residual order: sort each row's slots by folded
         # chord length (short first) and split the columns into a
         # short-chord and a long-chord bucket where the scales separate.

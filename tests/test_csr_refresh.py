@@ -66,17 +66,58 @@ class TestCsrRefresh:
         assert (csr.node_capacity, csr.edge_capacity) == shapes
         _check_matches_oracle(csr, ls)
 
-    def test_edge_set_change_rebuilds_at_same_shapes(self):
+    def test_edge_set_change_rewires_at_same_shapes(self):
         dbs = _square()
         ls = build(dbs)
         csr = CsrTopology.from_link_state(ls)
         shapes = (csr.node_capacity, csr.edge_capacity)
-        # remove link b<->d (edge-set change, still fits capacity)
+        ell_before = csr.ell
+        # remove link b<->d (edge-set change, still fits capacity):
+        # handled by the slot freelist in place, not a rebuild
         dbs[1].adjacencies = [a for a in dbs[1].adjacencies if a.other_node_name != "d"]
         ls.update_adjacency_database(dbs[1])
-        assert csr.refresh(ls) is False  # rebuilt
+        assert csr.refresh(ls) is True  # bounded rewire in place
+        assert csr.ell is ell_before  # ELL tables patched, not rebuilt
+        assert csr.rewire_seq == 1
+        assert len(csr._free_slots) == 2  # both directed slots retired
         assert (csr.node_capacity, csr.edge_capacity) == shapes
         assert csr.version == ls.version
+        _check_matches_oracle(csr, ls)
+
+    def test_node_set_change_rebuilds(self):
+        dbs = _square()
+        ls = build(dbs)
+        csr = CsrTopology.from_link_state(ls)
+        # a brand-new node is out of rewire scope -> full rebuild
+        ls.update_adjacency_database(adj_db("e", [adj("e", "a")]))
+        ls.update_adjacency_database(
+            adj_db("a", [adj("a", "b"), adj("a", "c"), adj("a", "e")])
+        )
+        assert csr.refresh(ls) is False  # rebuilt
+        assert csr.rewire_seq == 0
+        _check_matches_oracle(csr, ls)
+
+    def test_rewire_reuses_retired_slots(self):
+        dbs = _square()
+        ls = build(dbs)
+        csr = CsrTopology.from_link_state(ls)
+        e_before = csr.n_edges
+        # drop b<->d, then add a<->d: the two retired slots are reused
+        dbs[1].adjacencies = [a for a in dbs[1].adjacencies if a.other_node_name != "d"]
+        ls.update_adjacency_database(dbs[1])
+        assert csr.refresh(ls) is True
+        dbs2 = [
+            adj_db("a", [adj("a", "b"), adj("a", "c"), adj("a", "d")]),
+            adj_db("b", [adj("b", "a")]),
+            adj_db("c", [adj("c", "a"), adj("c", "d")]),
+            adj_db("d", [adj("d", "c"), adj("d", "a")]),
+        ]
+        for db in dbs2:
+            ls.update_adjacency_database(db)
+        assert csr.refresh(ls) is True
+        assert csr.n_edges == e_before  # no tail growth
+        assert csr._free_slots == []
+        assert csr.rewire_seq == 2
         _check_matches_oracle(csr, ls)
 
     def test_node_growth_beyond_capacity(self):

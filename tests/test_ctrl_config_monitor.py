@@ -418,6 +418,11 @@ class TestCounterRegistrySweep:
                 # the device-residency engine pre-seeds its registry, so
                 # the family is dumpable before any device query runs
                 "device.engine.queries",
+                # the edge-set rewire rung pre-seeds the same way: the
+                # runbook's rewire ledger is scrapeable before any OCS
+                # reconfiguration ever reaches the engine
+                "device.engine.rewire_dispatches",
+                "device.engine.rewire_fallbacks",
                 # the query scheduler pre-seeds serving.* the same way,
                 # and its admission RWQueue rides the daemon queue fabric
                 "serving.admitted",

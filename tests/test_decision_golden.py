@@ -1659,10 +1659,13 @@ class TestLongPublicationSequenceEngineBacked:
         c = engine.get_counters()
         assert c["device.engine.queries"] > 0
         assert c["device.engine.incremental_updates"] >= 10
-        # initial upload + six edge-set changes (link 1-3 down/up, adj-db
-        # expiry + re-announce of node 4, link 2-4 down/up) — everything
-        # else must have gone through the incremental path
-        assert c["device.engine.full_restages"] == 7
+        # initial upload + the two node-set changes (adj-db expiry +
+        # re-announce of node 4); the four bounded edge-set changes
+        # (link 1-3 down/up, link 2-4 down/up) ride the rewire rung in
+        # place, everything else goes through the incremental path
+        assert c["device.engine.full_restages"] == 3
+        assert c["device.engine.rewires"] == 4
+        assert c["device.engine.rewire_fallbacks"] == 0
         # settled state matches a freshly-built equivalent topology on
         # fresh solvers (the routes() harness)
         fresh = build_link_state(self.ring6(m12=15))
@@ -1736,3 +1739,89 @@ class TestDeltaPathEventParity:
         assert solver_delta.counters["decision.delta.events_coalesced"] >= 5
         # and the legacy solver never touched it
         assert solver_full.counters["decision.delta.updates"] == 0
+
+
+class TestOcsOverlayEdges:
+    """DecisionTest-tranche slice (ISSUE 11): static overlay edges
+    expressed as OCS-style edge injections.  A persistent dual-backend
+    solver pair consumes a base hexagon plus programmed overlay
+    circuits injected, swapped, and retired mid-stream; route parity
+    must hold at every step, a programmed circuit must actually attract
+    traffic, and every bounded injection rides the CSR slot freelist +
+    engine rewire rung — the graph uploads exactly once.
+    Ancestors: DecisionTest.cpp ParallelLinks / topology-overlay cases
+    (adjacency sets changing under persistent solvers)."""
+
+    @staticmethod
+    def hexagon(overlays=()):
+        """1-2-4-6-5-3-1 ring; `overlays` are extra (a, b, metric)
+        circuits injected symmetrically on both endpoints."""
+        adjs = {
+            "1": [adj("1", "2"), adj("1", "3")],
+            "2": [adj("2", "1"), adj("2", "4")],
+            "3": [adj("3", "1"), adj("3", "5")],
+            "4": [adj("4", "2"), adj("4", "6")],
+            "5": [adj("5", "3"), adj("5", "6")],
+            "6": [adj("6", "4"), adj("6", "5")],
+        }
+        for a, b, m in overlays:
+            adjs[a].append(adj(a, b, metric=m))
+            adjs[b].append(adj(b, a, metric=m))
+        return adjs
+
+    def test_overlay_injection_swap_and_retirement(self):
+        ls = build_link_state(self.hexagon())
+        ps = prefix_state_with(("6", "0", PrefixEntry(prefix=PFX)))
+        host = SpfSolver("1")
+        backend = DeviceSpfBackend(min_device_nodes=1, min_device_sources=1)
+        device = SpfSolver("1", spf_backend=backend)
+        engine = backend.engine
+
+        def push(overlays):
+            for node, adjs in self.hexagon(overlays).items():
+                ls.update_adjacency_database(
+                    AdjacencyDatabase(
+                        this_node_name=node, adjacencies=adjs, area="0"
+                    )
+                )
+
+        def check():
+            h = host.build_route_db({"0": ls}, ps)
+            d = device.build_route_db({"0": ls}, ps)
+            assert h.unicast_routes == d.unicast_routes
+            assert h.mpls_routes == d.mpls_routes
+            return h
+
+        # baseline: two equal 3-hop arms toward the advertiser
+        db = check()
+        assert nh_names(db.unicast_routes[PFX]) == {"2", "3"}
+
+        # inject the 1-6 circuit: programmed capacity attracts the flow
+        push([("1", "6", 5)])
+        db = check()
+        assert nh_names(db.unicast_routes[PFX]) == {"6"}
+
+        # second overlay elsewhere: parity through a 2-circuit overlay
+        push([("1", "6", 5), ("2", "5", 5)])
+        check()
+
+        # OCS swap: retire 1-6, program 3-6 — the flow follows the
+        # reprogrammed circuit through node 3
+        push([("2", "5", 5), ("3", "6", 5)])
+        db = check()
+        assert nh_names(db.unicast_routes[PFX]) == {"3"}
+
+        # retire every overlay: bit-exact return to the base ring
+        push([])
+        db = check()
+        assert nh_names(db.unicast_routes[PFX]) == {"2", "3"}
+
+        # all four edge-set events were bounded rewires on the same
+        # resident graph: one upload, zero fallbacks
+        c = engine.get_counters()
+        assert c["device.engine.full_restages"] == 1
+        assert c["device.engine.rewires"] == 4
+        assert c["device.engine.rewire_fallbacks"] == 0
+        # 2+2 injected slots, 2 swapped in place (retire+recycle share
+        # a slot), 4 retired on the final push
+        assert c["device.engine.rewire_slots"] >= 10

@@ -1,0 +1,213 @@
+"""OCS reconfiguration chaos family (ISSUE 11).
+
+Three layers:
+
+- OcsController scenario tests: a seeded rolling-rewire schedule over a
+  chorded WAN ring, interleaved with metric flaps and one injected
+  mid-rewire device fault.  Every round's SPF product — and the
+  post-heal all-sources sweep — must be bit-exact against the host
+  Dijkstra oracle, bounded rewires must ride the engine's rewire rung
+  (full_restages stays at the initial upload except for the scripted
+  fault demotion), and a second run from the same seed must produce an
+  identical ChaosEventLog.
+- Daemon-level rewires: live daemons on the spark fabric with circuits
+  connected/retired mid-flight, converging bit-exactly to their own
+  host-oracle recompute through hold-based ``wait_converged`` (write
+  counters pinned — the 1-CPU full-suite timing-flake pattern).
+- A randomized ``-m slow`` soak of the daemon-level loop under a
+  CPU-burner load, logging its seed for local replay.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+
+import pytest
+
+from openr_tpu.chaos import ChaosEventLog, ChaosScenario, OcsController
+from openr_tpu.chaos.scenario import fib_unicast_routes, oracle_route_dbs
+from openr_tpu.types import LinkEvent
+
+jax = pytest.importorskip("jax")
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(scope="module")
+def ocs_run():
+    log = ChaosEventLog()
+    result = OcsController(seed=11, log_=log).run()
+    return result, log
+
+
+class TestOcsController:
+    def test_rolling_rewires_ride_the_rewire_rung(self, ocs_run):
+        result, _ = ocs_run
+        # one rewire delta per round, minus the round the injected
+        # fault demoted to a restage
+        assert result.rewires == result.rounds - 1
+        assert result.rewire_dispatches == result.rounds - 1
+        assert result.rewire_fallbacks == 1  # the scripted fault
+        # initial upload + the fault demotion; nothing else restages
+        assert result.full_restages == 2
+        assert result.links_swapped == 2 * result.rounds
+        assert result.counters["device.engine.rewire_bytes_staged"] > 0
+
+    def test_bit_exact_every_round_and_post_heal(self, ocs_run):
+        result, _ = ocs_run
+        assert result.bit_exact
+        assert all(result.round_exact), result.round_exact
+
+    def test_fault_round_is_in_the_log(self, ocs_run):
+        _, log = ocs_run
+        events = log.scenario()
+        assert any(e.startswith("ocs:fault:armed:") for e in events)
+        assert any(e.startswith("ocs:fault:fired:") for e in events)
+        assert events[-1] == "ocs:settled:exact"
+
+    def test_same_seed_replays_bit_for_bit(self, ocs_run):
+        _, log = ocs_run
+        relog = ChaosEventLog()
+        OcsController(seed=11, log_=relog).run()
+        assert log.matches(relog), (log.scenario(), relog.scenario())
+
+    def test_different_seed_diverges(self, ocs_run):
+        _, log = ocs_run
+        other = ChaosEventLog()
+        OcsController(seed=12, log_=other).run()
+        assert not log.matches(other)
+
+    def test_unfaulted_run_keeps_single_restage(self):
+        result = OcsController(
+            seed=3, n=24, rounds=6, fault_round=-1
+        ).run()
+        assert result.bit_exact
+        assert result.full_restages == 1  # the acceptance invariant
+        assert result.rewire_fallbacks == 0
+        assert result.rewires == 6
+
+
+# -- daemon-level rewires -----------------------------------------------------
+
+
+def _chord_events(ring, a: int, b: int, *, up: bool, if_index: int) -> None:
+    """Announce (or retire) the chord interfaces on both endpoints."""
+    ring.daemons[a].netlink_events_queue.push(
+        LinkEvent(f"if-{a}-{b}", if_index, up)
+    )
+    ring.daemons[b].netlink_events_queue.push(
+        LinkEvent(f"if-{b}-{a}", if_index, up)
+    )
+
+
+def run_daemon_rewires(seed: int, rounds: int = 2):
+    """Rolling daemon-level rewires: per round, program a chord circuit
+    and retire a ring link, hold-converge, then heal back.  Returns the
+    log, the per-wait verdicts and the final (fib, oracle) tables."""
+    from test_chaos import ChaosRing
+
+    ring = ChaosRing(4, seed=seed)
+    try:
+        ring.advertise_loopbacks()
+        scenario = ChaosScenario(ring.log)
+        ok = scenario.wait("initial-mesh", ring.full_mesh, 45)
+        ok &= scenario.wait_converged(ring.daemons, 45)
+
+        for r in range(rounds):
+            # program the 0-2 chord circuit (edge-set add)
+            scenario.step(
+                f"ocs:connect:0-2:{r}",
+                lambda: ring.spark_fabric.connect(
+                    "openr-0", "if-0-2", "openr-2", "if-2-0"
+                ),
+            )
+            _chord_events(ring, 0, 2, up=True, if_index=7)
+            ok &= scenario.wait_converged(ring.daemons, 45)
+
+            # retire the 1-2 ring link (edge-set remove): traffic now
+            # rides the programmed chord
+            scenario.step(
+                f"ocs:retire:1-2:{r}",
+                lambda: ring.spark_fabric.disconnect(
+                    "openr-1", "if-1-2", "openr-2", "if-2-1"
+                ),
+            )
+            ok &= scenario.wait_converged(ring.daemons, 45)
+
+            # heal: restore the ring link, retire the chord
+            scenario.step(
+                f"ocs:heal:{r}",
+                lambda: ring.spark_fabric.connect(
+                    "openr-1", "if-1-2", "openr-2", "if-2-1"
+                ),
+            )
+            scenario.step(
+                f"ocs:unprogram:0-2:{r}",
+                lambda: ring.spark_fabric.disconnect(
+                    "openr-0", "if-0-2", "openr-2", "if-2-0"
+                ),
+            )
+            _chord_events(ring, 0, 2, up=False, if_index=7)
+            ok &= scenario.wait_converged(ring.daemons, 45)
+
+        ok &= scenario.wait("post-heal-mesh", ring.full_mesh, 45)
+        tables = {
+            d.config.node_name: fib_unicast_routes(d) for d in ring.daemons
+        }
+        oracle = {
+            d.config.node_name: oracle_route_dbs(d) for d in ring.daemons
+        }
+        return ring.log, ok, tables, oracle
+    finally:
+        ring.stop()
+
+
+class TestOcsDaemonRewires:
+    def test_rolling_circuit_swaps_converge_bit_exact(self):
+        log, ok, tables, oracle = run_daemon_rewires(seed=20260805)
+        assert ok, log.scenario()
+        assert tables == oracle  # bit-exact host-oracle convergence
+        assert len(tables) == 4 and all(tables.values())
+
+
+@pytest.mark.slow
+class TestOcsSoak:
+    def test_randomized_rewire_soak_under_cpu_burn(self):
+        """The daemon-level rewire loop on a loaded box: CPU burners
+        steal cycles so scenario waits only pass through the hold-based
+        convergence gate, never a lucky instantaneous poll."""
+        seed = int(
+            os.environ.get(
+                "OPENR_OCS_SEED", random.SystemRandom().randrange(2**31)
+            )
+        )
+        stop = threading.Event()
+
+        def burn():
+            x = 1
+            while not stop.is_set():
+                x = (x * 1103515245 + 12345) % (1 << 31)
+
+        burners = [
+            threading.Thread(target=burn, daemon=True) for _ in range(2)
+        ]
+        for b in burners:
+            b.start()
+        try:
+            log, ok, tables, oracle = run_daemon_rewires(seed, rounds=4)
+            assert ok, log.scenario()
+            assert tables == oracle
+            # controller soak rides along under the same load
+            result = OcsController(seed=seed, rounds=16).run()
+            assert result.bit_exact
+            assert result.rewire_fallbacks == 1  # the scripted fault
+        except AssertionError as exc:
+            raise AssertionError(
+                f"ocs soak failed; replay with OPENR_OCS_SEED={seed}: {exc}"
+            ) from exc
+        finally:
+            stop.set()
+            for b in burners:
+                b.join(timeout=5)
