@@ -1978,6 +1978,46 @@ def bench_ksp2_fattree10k() -> dict:
     )
 
 
+class _WanServingBackend:
+    """Serving batch-backend contract straight over the synthetic
+    wan arrays: run_paths returns {source: [N] distance row}.  Every
+    dispatch pads its source batch to one fixed S bucket, so the
+    whole run reuses a single compiled program (the engine ladder's
+    S-bucket discipline — a fresh S shape is a fresh XLA compile at
+    100k and would dominate the row).  Shared by the single-scheduler
+    serving row and the replica-fleet row (every replica dispatches
+    into the same compiled program, like K daemons on one device)."""
+
+    def __init__(self, topo, s_pad: int) -> None:
+        self.runner = topo.runner
+        self.n_nodes = topo.n_nodes
+        self.s_pad = s_pad
+        self._epoch = 0
+
+    def epoch(self, area: str) -> int:
+        return self._epoch
+
+    def run_paths(
+        self, area, sources, use_link_metric=True, expect_epoch=0
+    ) -> dict:
+        from openr_tpu.device.engine import EpochMismatchError
+
+        if int(expect_epoch) != self._epoch:
+            raise EpochMismatchError(int(expect_epoch), self._epoch)
+        srcs = [int(s) for s in sources]
+        out: dict = {}
+        for lo in range(0, len(srcs), self.s_pad):
+            chunk = srcs[lo : lo + self.s_pad]
+            padded = chunk + [chunk[0]] * (self.s_pad - len(chunk))
+            dist, _ = self.runner.forward(
+                np.asarray(padded, np.int32), want_dag=False
+            )
+            dist = np.asarray(dist)[:, : self.n_nodes]
+            for i, s in enumerate(chunk):
+                out[s] = dist[i].copy()
+        return out
+
+
 def bench_serving_load_wan100k(
     topo, clients: int = 6, qps_per_client: float = 30.0, duration_s: float = 3.0
 ) -> dict:
@@ -1990,45 +2030,10 @@ def bench_serving_load_wan100k(
     parity sample of batched replies against serial single-query
     dispatches of the same backend."""
     from openr_tpu.chaos.overload import OpenLoopLoadGen
-    from openr_tpu.device.engine import EpochMismatchError
     from openr_tpu.serving import QueryScheduler
 
     s_pad = 16
-
-    class _WanServingBackend:
-        """Serving batch-backend contract straight over the synthetic
-        wan arrays: run_paths returns {source: [N] distance row}.  Every
-        dispatch pads its source batch to one fixed S bucket, so the
-        whole run reuses a single compiled program (the engine ladder's
-        S-bucket discipline — a fresh S shape is a fresh XLA compile at
-        100k and would dominate the row)."""
-
-        def __init__(self) -> None:
-            self.runner = topo.runner
-            self._epoch = 0
-
-        def epoch(self, area: str) -> int:
-            return self._epoch
-
-        def run_paths(
-            self, area, sources, use_link_metric=True, expect_epoch=0
-        ) -> dict:
-            if int(expect_epoch) != self._epoch:
-                raise EpochMismatchError(int(expect_epoch), self._epoch)
-            srcs = [int(s) for s in sources]
-            out: dict = {}
-            for lo in range(0, len(srcs), s_pad):
-                chunk = srcs[lo : lo + s_pad]
-                padded = chunk + [chunk[0]] * (s_pad - len(chunk))
-                dist, _ = self.runner.forward(
-                    np.asarray(padded, np.int32), want_dag=False
-                )
-                dist = np.asarray(dist)[:, : topo.n_nodes]
-                for i, s in enumerate(chunk):
-                    out[s] = dist[i].copy()
-            return out
-
-    backend = _WanServingBackend()
+    backend = _WanServingBackend(topo, s_pad)
     # warm: compile the padded program + learn the sweep hint before the
     # clock starts (every later dispatch reuses it)
     backend.run_paths("0", list(range(s_pad)))
@@ -2077,6 +2082,119 @@ def bench_serving_load_wan100k(
         "admission_overflows": sched.admission.stats()["overflows"],
         "parity_sample": len(sample),
         "parity_ok": parity_ok,
+    }
+
+
+def bench_serving_fleet_wan100k(
+    topo,
+    clients: int = 6,
+    qps_per_client: float = 30.0,
+    duration_s: float = 2.0,
+) -> dict:
+    """Replica-fleet front door at wan100k: the SAME open-loop load as
+    serving_load_wan100k, submitted through a ReplicaRouter over K
+    QueryScheduler replicas sharing one compiled program.  Reports
+    aggregate qps/p50/p99 at 1 vs 2 vs 4 replicas (the router-overhead
+    and spread curve), then a mid-run replica-kill segment at K=2: one
+    replica's scheduler stops while clients keep submitting, and the
+    row records the p99 delta vs the undisturbed K=2 segment plus the
+    zero-silent-drops ledger and the router's failover/retry counters.
+    Honors OPENR_BENCH_BUDGET_S: later fleet sizes (and the kill
+    segment) shed whole rather than being killed mid-segment."""
+    import threading
+
+    from openr_tpu.chaos.overload import OpenLoopLoadGen
+    from openr_tpu.serving import (
+        QueryScheduler,
+        ReplicaRouter,
+        SchedulerReplica,
+    )
+
+    s_pad = 16
+    backend = _WanServingBackend(topo, s_pad)
+    # warm: compile the padded program before any segment's clock starts
+    backend.run_paths("0", list(range(s_pad)))
+
+    nodes = [int(s) for s in _wan_router_sources(topo)]
+    nodes += [int(x) for x in range(0, topo.n_nodes, topo.n_nodes // 64)]
+
+    def fleet(k: int):
+        scheds = [
+            QueryScheduler(backend, max_pending=8192, max_coalesce=s_pad)
+            for _ in range(k)
+        ]
+        for s in scheds:
+            s.run()
+        router = ReplicaRouter(
+            [SchedulerReplica(f"rep-{i}", s) for i, s in enumerate(scheds)],
+            hedge_after_s=0.05 if k > 1 else None,
+        )
+        return router, scheds
+
+    def segment(k: int, kill_at_s: Optional[float] = None):
+        router, scheds = fleet(k)
+        killer = None
+        try:
+            gen = OpenLoopLoadGen(
+                router, nodes=nodes, seed=7, clients=clients, sessions=True
+            )
+            if kill_at_s is not None:
+                killer = threading.Timer(kill_at_s, scheds[-1].stop)
+                killer.start()
+            report = gen.run_paced(
+                duration_s, qps_per_client, gather_timeout_s=300.0
+            )
+            counters = router.get_counters()
+        finally:
+            if killer is not None:
+                killer.cancel()
+            router.stop()
+            for s in scheds:
+                s.stop()
+        return report, counters
+
+    scaling: dict = {}
+    for k in (1, 2, 4):
+        if _budget_left() < 3 * duration_s + 10:
+            scaling[str(k)] = None  # shed whole
+            continue
+        report, _counters = segment(k)
+        scaling[str(k)] = {
+            "submitted": report.submitted,
+            "sustained_qps": round(report.qps, 1),
+            "p50_us": report.pctl_us(50),
+            "p99_us": report.pctl_us(99),
+            "shed": report.shed,
+            "errors": report.errors,
+            "zero_silent_drops": report.accounted == report.submitted,
+        }
+
+    kill_segment = None
+    base2 = scaling.get("2")
+    if base2 is not None and _budget_left() >= 3 * duration_s + 10:
+        report, counters = segment(2, kill_at_s=duration_s / 2)
+        kill_segment = {
+            "killed_at_s": round(duration_s / 2, 2),
+            "submitted": report.submitted,
+            "replied": report.replied,
+            "shed": report.shed,
+            "errors": report.errors,
+            "zero_silent_drops": report.accounted == report.submitted,
+            "p99_us": report.pctl_us(99),
+            "p99_delta_us": report.pctl_us(99) - base2["p99_us"],
+            "router_retries": counters["serving.router.retries"],
+            "router_failovers": counters["serving.router.failovers"],
+            "router_replica_deaths": counters[
+                "serving.router.replica_deaths"
+            ],
+        }
+
+    return {
+        "clients": clients,
+        "offered_qps": round(clients * qps_per_client, 1),
+        "duration_s": duration_s,
+        "replica_scaling": scaling,
+        "replica_kill": kill_segment,
     }
 
 
@@ -2240,6 +2358,10 @@ DEVICE_ROWS = {
     # query-serving layer under open-loop load: sustained qps, p50/p99,
     # batch occupancy through admission/coalescing/double-buffering
     "serving_load_wan100k": lambda t: bench_serving_load_wan100k(t.wan),
+    # replica-fleet front door: aggregate qps at 1/2/4 replicas through
+    # the ReplicaRouter, plus a mid-run replica-kill segment (p99 delta,
+    # zero-silent-drops ledger, failover counters)
+    "serving_fleet_wan100k": lambda t: bench_serving_fleet_wan100k(t.wan),
     # differentiable TE: gradient-descent metric optimization with the
     # exact-solver acceptance gate vs host hill-climb at equal exact
     # evaluations (openr_tpu/te; docs/OPERATIONS.md "TE runbook")

@@ -17,11 +17,22 @@ from .chaos import (
 from .flapstorm import FlapStormResult, FlapStormScenario
 from .ocs import OcsController, OcsRewireResult
 from .overload import LoadReport, OpenLoopLoadGen
-from .scenario import ChaosScenario, fib_unicast_routes, oracle_route_dbs
+from .replicafleet import (
+    ChaosReplicaHandle,
+    ReplicaFleetController,
+    ReplicaFleetResult,
+)
+from .scenario import (
+    ChaosScenario,
+    fib_unicast_routes,
+    hold_converged,
+    oracle_route_dbs,
+)
 
 __all__ = [
     "ChaosEventLog",
     "ChaosIoProvider",
+    "ChaosReplicaHandle",
     "ChaosScenario",
     "ChaosSpfBackend",
     "FibChaosPlan",
@@ -33,6 +44,9 @@ __all__ = [
     "OcsController",
     "OcsRewireResult",
     "OpenLoopLoadGen",
+    "ReplicaFleetController",
+    "ReplicaFleetResult",
     "fib_unicast_routes",
+    "hold_converged",
     "oracle_route_dbs",
 ]

@@ -88,31 +88,49 @@ class OpenLoopLoadGen:
         seed: int = 0,
         clients: int = 8,
         ops: tuple = ("paths",),
+        sessions: bool = False,
+        on_reply=None,
     ) -> None:
         self.scheduler = scheduler
         self.nodes = list(nodes)
         self.seed = int(seed)
         self.clients = int(clients)
         self.ops = tuple(ops)
+        # sessions=True tags every client's queries with a per-client
+        # session id for the router's epoch pinning — only valid when
+        # `scheduler` accepts a `session` kwarg (serving.ReplicaRouter)
+        self.sessions = bool(sessions)
+        # on_reply((op, src, session), QueryResult) runs during gather for
+        # every successful reply — the chaos families hang their per-epoch
+        # bit-exactness oracle checks here
+        self.on_reply = on_reply
 
-    def _submit_one(self, rng: random.Random):
+    def _submit_one(self, rng: random.Random, client_i: int):
         op = rng.choice(self.ops)
         src = rng.choice(self.nodes)
+        kw: dict = {}
+        session = f"client-{client_i}" if self.sessions else None
+        if session is not None:
+            kw["session"] = session
         if op == "paths":
-            return self.scheduler.submit("paths", sources=(src,))
-        if op == "what_if":
+            fut = self.scheduler.submit("paths", sources=(src,), **kw)
+        elif op == "what_if":
             a, b = rng.sample(self.nodes, 2)
-            return self.scheduler.submit(
-                "what_if", sources=(src,), scenarios=(((a, b),),)
+            fut = self.scheduler.submit(
+                "what_if", sources=(src,), scenarios=(((a, b),),), **kw
             )
-        dest = rng.choice([n for n in self.nodes if n != src])
-        return self.scheduler.submit("ksp", sources=(src,), dests=(dest,))
+        else:
+            dest = rng.choice([n for n in self.nodes if n != src])
+            fut = self.scheduler.submit(
+                "ksp", sources=(src,), dests=(dest,), **kw
+            )
+        return fut, (op, src, session)
 
     def _gather(
         self, futures: list, report: LoadReport, timeout_s: float
     ) -> None:
         deadline = time.monotonic() + timeout_s
-        for fut in futures:
+        for fut, meta in futures:
             budget = max(0.0, deadline - time.monotonic())
             try:
                 res = fut.result(timeout=budget)
@@ -128,6 +146,8 @@ class OpenLoopLoadGen:
                 report.replied += 1
                 report.latencies_us.append(res.latency_us)
                 report.batch_sizes.append(res.batch_size)
+                if self.on_reply is not None:
+                    self.on_reply(meta, res)
 
     def run_burst(
         self, per_client: int, gather_timeout_s: float = 60.0
@@ -140,7 +160,7 @@ class OpenLoopLoadGen:
 
         def client(i: int) -> None:
             rng = random.Random(self.seed * 1000 + i)
-            futures = [self._submit_one(rng) for _ in range(per_client)]
+            futures = [self._submit_one(rng, i) for _ in range(per_client)]
             with lock:
                 all_futures.extend(futures)
 
@@ -177,7 +197,7 @@ class OpenLoopLoadGen:
             t_next = time.monotonic()
             t_end = t_next + duration_s
             while time.monotonic() < t_end:
-                futures.append(self._submit_one(rng))
+                futures.append(self._submit_one(rng, i))
                 t_next += period
                 delay = t_next - time.monotonic()
                 if delay > 0:

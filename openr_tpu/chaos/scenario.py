@@ -62,6 +62,45 @@ def fib_matches_oracle(daemon) -> bool:
     return fib_unicast_routes(daemon) == oracle_route_dbs(daemon)
 
 
+def hold_converged(
+    daemons, timeout_s: float = 30.0, hold_s: float = 0.5
+) -> bool:
+    """True once every daemon's FIB bit-exactly matches its own host-
+    oracle recompute AND the match holds for a full ``hold_s`` quiescence
+    window with no new route publications.
+
+    Two instantaneous polls are not enough on a loaded box: a rebuild can
+    land between the FIB read and the oracle read, or (worse) the match
+    can be momentarily true while a late update is still queued, so a
+    snapshot taken right after the wait races the final write.  The hold
+    window requires the match to stay continuously true and pins the
+    daemons' route-publication write counters across it — if anything
+    publishes mid-window the hold restarts from the new state.
+    """
+
+    def _writes() -> tuple[int, ...]:
+        return tuple(d.route_updates_queue.get_num_writes() for d in daemons)
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if not all(fib_matches_oracle(d) for d in daemons):
+            time.sleep(0.05)
+            continue
+        w0 = _writes()
+        hold_end = time.monotonic() + hold_s
+        held = True
+        while time.monotonic() < hold_end:
+            time.sleep(0.05)
+            if _writes() != w0 or not all(
+                fib_matches_oracle(d) for d in daemons
+            ):
+                held = False
+                break
+        if held and _writes() == w0:
+            return True
+    return False
+
+
 class ChaosScenario:
     """A replayable fault timeline: named steps plus logged waits."""
 
@@ -100,33 +139,12 @@ class ChaosScenario:
         so a snapshot taken right after the wait races the final write.
         The hold window requires the match to stay true continuously and
         pins the daemons' route-publication write counters across it — if
-        anything publishes mid-window the hold restarts from the new state.
-        The log entry stays ``converged:ok``/``converged:timeout`` so
-        same-seed replay logs still compare equal.
+        anything publishes mid-window the hold restarts from the new state
+        (module-level ``hold_converged``).  The log entry stays
+        ``converged:ok``/``converged:timeout`` so same-seed replay logs
+        still compare equal.
         """
-
-        def _writes() -> tuple[int, ...]:
-            return tuple(
-                d.route_updates_queue.get_num_writes() for d in daemons
-            )
-
-        deadline = time.monotonic() + timeout_s
-        ok = False
-        while time.monotonic() < deadline and not ok:
-            if not all(fib_matches_oracle(d) for d in daemons):
-                time.sleep(0.05)
-                continue
-            w0 = _writes()
-            hold_end = time.monotonic() + hold_s
-            held = True
-            while time.monotonic() < hold_end:
-                time.sleep(0.05)
-                if _writes() != w0 or not all(
-                    fib_matches_oracle(d) for d in daemons
-                ):
-                    held = False
-                    break
-            ok = held and _writes() == w0
+        ok = hold_converged(daemons, timeout_s=timeout_s, hold_s=hold_s)
         self.log.append(
             SCENARIO_STREAM, f"converged:{'ok' if ok else 'timeout'}"
         )

@@ -310,6 +310,12 @@ class OpenrCtrlHandler:
         on its future (no executor thread held while queued/coalesced).
         Sheds surface as explicit QueryShedError wire errors."""
         serving = self._need(self.serving, "serving")
+        kw: dict = {}
+        if p.get("session") and getattr(serving, "supports_sessions", False):
+            # fleet front-door (serving.ReplicaRouter): a client-supplied
+            # session id opts into epoch pinning — replies only ever move
+            # forward in topology version for that session
+            kw["session"] = str(p["session"])
         fut = serving.submit(
             op,
             area=p.get("area", "0"),
@@ -321,6 +327,7 @@ class OpenrCtrlHandler:
             dests=p.get("dests") or (),
             k=p.get("k", 2),
             use_link_metric=p.get("useLinkMetric", True),
+            **kw,
         )
         res = await asyncio.wrap_future(fut)
         return {

@@ -465,6 +465,162 @@ class OpenrDaemon:
         self.config_store.close()
 
 
+def fleet_node_config(name: str, ctrl_port: int = 0) -> OpenrConfig:
+    """Fast-timer config for an in-process serving-fleet replica (the
+    OpenrWrapper posture: mock fabrics, no watchdog, sub-second Spark)."""
+    from .config import AreaConf, DecisionConf, SparkConf
+
+    return OpenrConfig(
+        node_name=name,
+        areas=[AreaConf()],
+        openr_ctrl_port=ctrl_port,
+        spark_config=SparkConf(
+            hello_time_s=0.3,
+            fastinit_hello_time_ms=20,
+            keepalive_time_s=0.05,
+            hold_time_s=0.5,
+            graceful_restart_time_s=1.0,
+        ),
+        decision_config=DecisionConf(debounce_min_ms=5, debounce_max_ms=20),
+        enable_watchdog=False,
+        node_label=0,
+    ).validate()
+
+
+class ServingFleet:
+    """K full daemons in one process, peered over a KvStore full-mesh and
+    fronted by one serving.ReplicaRouter — the replica-fleet serving
+    posture (docs/ARCHITECTURE.md "Replica fleet").
+
+    Every daemon runs the whole stack (Spark adjacency over a mock
+    fabric, KvStore flooding, Decision, serving.QueryScheduler), so each
+    replica independently converges to the same LinkState version and can
+    answer any query at its current epoch.  The router spreads queries
+    across the K schedulers with per-session epoch pinning, health-aware
+    failover, and bounded hedging; `handler` is the front-door
+    OpenrCtrlHandler whose queryPaths/queryWhatIf/queryKsp go through the
+    router, so the fleet looks like one daemon to ctrl clients while
+    serving.router.* counters expose the spread.
+    """
+
+    def __init__(
+        self,
+        k: int = 3,
+        *,
+        node_prefix: str = "fleet",
+        hedge_after_s: float = 0.05,
+        config_fn=None,
+        spf_backend: Optional[SpfBackend] = None,
+        use_device_spf: bool = True,
+    ) -> None:
+        from .kvstore import InProcessTransport
+        from .spark import MockIoProvider
+
+        if k < 1:
+            raise ValueError("ServingFleet needs at least one replica")
+        make = config_fn or fleet_node_config
+        self.spark_fabric = MockIoProvider()
+        self.kv_fabric = InProcessTransport()
+        self.daemons: list[OpenrDaemon] = []
+        self._names: list[str] = []
+        for i in range(k):
+            name = f"{node_prefix}-{i}"
+            addr = f"fe80::{name}"
+            daemon = OpenrDaemon(
+                make(name),
+                io_provider=self.spark_fabric.endpoint(name),
+                kvstore_transport=self.kv_fabric.bind(addr),
+                spark_v6_addr=addr,
+                spf_backend=spf_backend,
+                use_device_spf=use_device_spf,
+            )
+            self.kv_fabric.register(addr, daemon.kvstore)
+            self.daemons.append(daemon)
+            self._names.append(name)
+        self._hedge_after_s = hedge_after_s
+        self.router = None  # serving.ReplicaRouter (built in start())
+        self.handler = None  # front-door OpenrCtrlHandler over the router
+
+    def start(self) -> None:
+        from .serving import ReplicaRouter, SchedulerReplica
+        from .types import LinkEvent
+
+        for daemon in self.daemons:
+            daemon.start()
+        # full-mesh adjacency: every replica peers with every other, so
+        # one surviving replica keeps the whole fleet's KvStore coherent
+        # through any single partition
+        k = len(self.daemons)
+        for i in range(k):
+            for j in range(i + 1, k):
+                self.spark_fabric.connect(
+                    self._names[i],
+                    f"if-{i}-{j}",
+                    self._names[j],
+                    f"if-{j}-{i}",
+                )
+        for i, daemon in enumerate(self.daemons):
+            for j in range(k):
+                if j == i:
+                    continue
+                daemon.netlink_events_queue.push(
+                    LinkEvent(f"if-{i}-{j}", j + 1, True)
+                )
+        self.router = ReplicaRouter(
+            [
+                SchedulerReplica(self._names[i], d.serving)
+                for i, d in enumerate(self.daemons)
+            ],
+            hedge_after_s=self._hedge_after_s if k > 1 else None,
+        )
+        # front door: daemon 0's introspection surfaces plus the router
+        # as the serving module — queryPaths et al spread over the fleet
+        front = self.daemons[0]
+        self.handler = OpenrCtrlHandler(
+            f"{self._names[0]}-front",
+            kvstore=front.kvstore,
+            decision=front.decision,
+            fib=front.fib,
+            link_monitor=front.link_monitor,
+            prefix_manager=front.prefix_manager,
+            spark=front.spark,
+            monitor=front.monitor,
+            config=front.config,
+            serving=self.router,
+            queues=front._queues,
+        )
+
+    def wait_converged(self, timeout_s: float = 30.0) -> bool:
+        """True once every replica's Decision sees the full mesh AND all
+        replicas answer the same topology epoch — the fleet precondition
+        for cross-replica bit-identical replies."""
+        import time
+
+        k = len(self.daemons)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            link_states = [
+                d.decision.area_link_states.get("0") for d in self.daemons
+            ]
+            if all(
+                ls is not None and len(ls.node_names) == k
+                for ls in link_states
+            ):
+                epochs = {
+                    d.serving.backend.epoch("0") for d in self.daemons
+                }
+                if len(epochs) == 1:
+                    return True
+            time.sleep(0.05)
+        return False
+
+    def stop(self) -> None:
+        if self.router is not None:
+            self.router.stop()
+        for daemon in self.daemons:
+            daemon.stop()
+
+
 def build_flag_parser() -> argparse.ArgumentParser:
     """Process-level flag surface (reference: openr/common/Flags.cpp — the
     operationally-relevant subset; most knobs live in the JSON config, and

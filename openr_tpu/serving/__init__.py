@@ -12,6 +12,12 @@ docs/ARCHITECTURE.md "Query-serving layer".
 """
 
 from .backend import DecisionBatchBackend, EngineBatchBackend
+from .router import (
+    ROUTER_COUNTER_KEYS,
+    ReplicaRouter,
+    ReplicaUnavailableError,
+    SchedulerReplica,
+)
 from .scheduler import (
     SERVING_COUNTER_KEYS,
     Query,
@@ -27,5 +33,9 @@ __all__ = [
     "QueryResult",
     "QueryScheduler",
     "QueryShedError",
+    "ReplicaRouter",
+    "ReplicaUnavailableError",
+    "ROUTER_COUNTER_KEYS",
+    "SchedulerReplica",
     "SERVING_COUNTER_KEYS",
 ]

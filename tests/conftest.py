@@ -31,6 +31,8 @@ jax.config.update("jax_platforms", "cpu")
 if jax.default_backend() != "cpu":
     jax.config.update("jax_default_device", jax.devices("cpu")[0])
 
+import threading  # noqa: E402
+
 import pytest  # noqa: E402
 
 
@@ -39,3 +41,48 @@ def cpu_devices():
     import jax
 
     return jax.devices("cpu")
+
+
+class CpuBurner:
+    """Background threads spinning pure-Python arithmetic to steal GIL
+    slices from the test body.
+
+    On this 1-CPU container the chaos suites only flake when the whole
+    suite runs — other tests' threads perturb scheduling enough that a
+    convergence wait which merely *polled once* passes standalone and
+    races under load.  Burners reproduce that contention deterministically
+    in a single test, so hold-based waits (pinned write counters, observed
+    quiescence) are exercised rather than lucky instantaneous polls.
+    """
+
+    def __init__(self, threads: int = 2) -> None:
+        self._stop = threading.Event()
+        self._threads = [
+            threading.Thread(target=self._burn, daemon=True, name=f"burn-{i}")
+            for i in range(threads)
+        ]
+
+    def _burn(self) -> None:
+        x = 1
+        while not self._stop.is_set():
+            x = (x * 1103515245 + 12345) % (1 << 31)
+
+    def start(self) -> "CpuBurner":
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+
+
+@pytest.fixture(scope="module")
+def cpu_burner():
+    """Shared CPU-contention fixture for the chaos suites (test_ocs,
+    test_chaos, test_flapstorm, test_replicafleet).  Module-scoped so
+    module- and class-scoped scenario fixtures can run under it."""
+    burner = CpuBurner(threads=2).start()
+    yield burner
+    burner.stop()

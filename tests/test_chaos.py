@@ -36,7 +36,7 @@ from openr_tpu.chaos import (
     oracle_route_dbs,
 )
 from openr_tpu.chaos.chaos import SCENARIO_STREAM, wait_until
-from openr_tpu.chaos.scenario import fib_matches_oracle
+from openr_tpu.chaos.scenario import hold_converged
 from openr_tpu.ctrl import OpenrCtrlHandler
 from openr_tpu.decision.spf_solver import HostSpfBackend
 from openr_tpu.fib import MockFibAgent
@@ -411,8 +411,10 @@ class TestDegradationLadder:
             assert wait_until(
                 lambda: route_queue.stats()["depth"] == 0, 10
             )
-            # and the published routes are bit-exact host-oracle routes
-            assert wait_until(lambda: fib_matches_oracle(d0), 10), (
+            # and the published routes are bit-exact host-oracle routes —
+            # hold-based with pinned write counters: a single-instant
+            # match can race a rebuild still in flight on a loaded box
+            assert hold_converged([d0], 10), (
                 fib_unicast_routes(d0),
                 oracle_route_dbs(d0),
             )
@@ -453,7 +455,9 @@ class TestDegradationLadder:
             assert counters.get("decision.route_rebuild_fallbacks", 0) >= 1
             assert counters.get("decision.device_fallbacks", 0) >= 1
             assert isinstance(solver.spf, HostSpfBackend)  # demoted
-            assert wait_until(lambda: fib_matches_oracle(d0), 10)
+            # hold-based: the post-fallback product must match the oracle
+            # through a quiescence window, not at one lucky instant
+            assert hold_converged([d0], 10)
         finally:
             ring.stop()
 
@@ -709,7 +713,10 @@ class TestChaosScenario:
 
 @pytest.mark.slow
 class TestChaosSoak:
-    def test_randomized_soak(self):
+    def test_randomized_soak(self, cpu_burner):
+        # the shared burner (tests/conftest.py) keeps the box loaded so
+        # the scenario's hold-based waits are exercised under the
+        # contention that used to surface only in full-suite runs
         seed = int(
             os.environ.get(
                 "OPENR_CHAOS_SEED", random.SystemRandom().randrange(2**31)

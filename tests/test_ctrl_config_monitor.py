@@ -527,6 +527,70 @@ class TestCounterRegistrySweep:
             shim.wait_until_stopped(5)
         assert set(SERVING_COUNTER_KEYS) <= set(shimmed)
 
+    def test_router_family_on_both_wire_surfaces(self, daemon):
+        """The replica-fleet front door pre-seeds serving.router.* and
+        rides the same two surfaces: a ctrl server whose serving module
+        is the ReplicaRouter (the fleet front-door posture), and the
+        fb303 shim fed by that handler's merged dump.  The router's
+        get_counters also rolls up its replicas' serving.* families, so
+        one scrape covers the whole fleet."""
+        import re
+
+        from openr_tpu.ctrl import CtrlServer, OpenrCtrlHandler
+        from openr_tpu.interop import thrift_binary as tb
+        from openr_tpu.interop.shim import ThriftBinaryShim
+        from openr_tpu.serving import (
+            ReplicaRouter,
+            ROUTER_COUNTER_KEYS,
+            SchedulerReplica,
+        )
+        from test_thrift_binary import _call_ok
+
+        router = ReplicaRouter(
+            [SchedulerReplica("solo", daemon.serving)], hedge_after_s=None
+        )
+        handler = OpenrCtrlHandler("fleet-front", serving=router)
+        server = CtrlServer(handler, port=0)
+        server.run()
+        try:
+            client = CtrlClient(port=server.port)
+            try:
+                native = client.call("getCounters")
+            finally:
+                client.close()
+        finally:
+            server.stop()
+            server.wait_until_stopped(5)
+        # pre-seeded: the whole family dumps before any dispatch
+        assert set(ROUTER_COUNTER_KEYS) <= set(native)
+        # fleet roll-up: the replica's serving.* rides the same dump
+        assert "serving.admitted" in native
+
+        shim = ThriftBinaryShim(
+            daemon.kvstore,
+            port=0,
+            node_name="solo",
+            counters_fn=handler._all_counters,
+        )
+        shim.run()
+        try:
+            shimmed = _call_ok(
+                shim.port,
+                "getCounters",
+                43,
+                b"\x00",
+                ("map", tb.T_STRING, tb.T_I64),
+                dec=lambda m: {k.decode(): v for k, v in m.items()},
+            )
+        finally:
+            shim.stop()
+            shim.wait_until_stopped(5)
+            router.stop()
+        assert set(ROUTER_COUNTER_KEYS) <= set(shimmed)
+
+        name_re = re.compile(r"[a-z][a-z0-9_]*(\.[a-z0-9_]+)+\Z")
+        assert all(name_re.match(k) for k in ROUTER_COUNTER_KEYS)
+
     def test_mesh_blocked_family_on_both_wire_surfaces(self, daemon):
         """The full mesh.blocked.* registry (blocked node-sharded APSP
         rung: products, rounds, panel broadcasts, bytes, phase timers,

@@ -1131,6 +1131,146 @@ class TestRibPolicyInteractions:
             policy(RibPolicyStatementConfig(name="no-action", prefixes=[PFX]))
 
 
+class TestRibPolicyAreaInteractions:
+    """Ancestors: DecisionTestFixture.RibPolicy (DecisionTest.cpp:5644)
+    x MultiAreaBestPathCalculation (:5420) — the policy's area-keyed
+    weight action applied over genuinely multi-area route DBs (the
+    two_areas() topology: node 1 spans area 0 via 2 and area 1 via 3),
+    computed by BOTH backends through routes()."""
+
+    @staticmethod
+    def cross_area_db(ps=None):
+        areas = TestMultiAreaRedistribution.two_areas()
+        if ps is None:
+            ps = prefix_state_with(
+                ("2", "0", PrefixEntry(prefix=PFX)),
+                ("3", "1", PrefixEntry(prefix=PFX)),
+            )
+        return routes("1", areas, ps)
+
+    def test_area_weight_zero_drops_one_areas_arm(self):
+        # steer all traffic onto the area-0 arm: area-1 weight 0 drops
+        # the cross-area next-hop entirely, not just down-weights it
+        db = self.cross_area_db()
+        route = db.unicast_routes[PFX]
+        assert {nh.area for nh in route.nexthops} == {"0", "1"}
+        pol = policy(
+            RibPolicyStatementConfig(
+                name="drain-area-1",
+                prefixes=[PFX],
+                set_weight=RibRouteActionWeight(
+                    default_weight=1, area_to_weight={"0": 1, "1": 0}
+                ),
+            )
+        )
+        assert pol.apply_policy(db.unicast_routes).updated_routes == [PFX]
+        assert {nh.area for nh in route.nexthops} == {"0"}
+        assert nh_names(route) == {"2"}
+
+    def test_all_areas_zeroed_retains_cross_area_ecmp(self):
+        # the blackhole guard (RibPolicy.cpp:146-158) must hold when the
+        # zeros arrive via the area map rather than default_weight
+        db = self.cross_area_db()
+        route = db.unicast_routes[PFX]
+        before = set(route.nexthops)
+        pol = policy(
+            RibPolicyStatementConfig(
+                name="drain-everything",
+                prefixes=[PFX],
+                set_weight=RibRouteActionWeight(
+                    default_weight=1, area_to_weight={"0": 0, "1": 0}
+                ),
+            )
+        )
+        assert pol.apply_policy(db.unicast_routes).updated_routes == []
+        assert set(route.nexthops) == before
+
+    def test_neighbor_weight_overrides_area_weight_cross_area(self):
+        # neighbor 3 sits in area 1; its per-neighbor weight must beat
+        # the area-1 weight while area 0 keeps its area-level value
+        db = self.cross_area_db()
+        route = db.unicast_routes[PFX]
+        pol = policy(
+            RibPolicyStatementConfig(
+                name="nb-beats-area",
+                prefixes=[PFX],
+                set_weight=RibRouteActionWeight(
+                    default_weight=1,
+                    area_to_weight={"0": 5, "1": 2},
+                    neighbor_to_weight={"3": 9},
+                ),
+            )
+        )
+        assert pol.apply_policy(db.unicast_routes).updated_routes == [PFX]
+        assert weights_by_neighbor(route) == {"2": 5, "3": 9}
+
+    def test_unknown_area_falls_back_to_default_weight(self):
+        # the weight map names an area that is not in the route: both
+        # arms take default_weight (RibPolicy.cpp's map lookup fallback)
+        db = self.cross_area_db()
+        route = db.unicast_routes[PFX]
+        pol = policy(
+            RibPolicyStatementConfig(
+                name="no-such-area",
+                prefixes=[PFX],
+                set_weight=RibRouteActionWeight(
+                    default_weight=4, area_to_weight={"9": 1}
+                ),
+            )
+        )
+        assert pol.apply_policy(db.unicast_routes).updated_routes == [PFX]
+        assert weights_by_neighbor(route) == {"2": 4, "3": 4}
+
+    def test_prefix_matcher_scopes_to_one_areas_prefix(self):
+        # distinct prefixes advertised from different areas: the policy
+        # transforms only the matched one, leaving the other area's
+        # route untouched — weights stay the solver's defaults
+        ps = prefix_state_with(
+            ("2", "0", PrefixEntry(prefix=PFX)),
+            ("3", "1", PrefixEntry(prefix="::2:0/112")),
+        )
+        db = self.cross_area_db(ps)
+        other_before = set(db.unicast_routes["::2:0/112"].nexthops)
+        pol = policy(
+            RibPolicyStatementConfig(
+                name="area0-prefix-only",
+                prefixes=[PFX],
+                set_weight=RibRouteActionWeight(default_weight=6),
+            )
+        )
+        change = pol.apply_policy(db.unicast_routes)
+        assert change.updated_routes == [PFX]
+        assert all(
+            nh.weight == 6 for nh in db.unicast_routes[PFX].nexthops
+        )
+        assert set(db.unicast_routes["::2:0/112"].nexthops) == other_before
+
+    def test_redistribution_consumer_sees_area_weight(self):
+        # SelfReditributePrefixPublication (:5563) interaction: node 2
+        # reaches the area-1 prefix via node 1's area-0 re-advertisement,
+        # so from 2's perspective the route is purely area-0 and the
+        # area-0 weight applies to the single next-hop
+        areas = TestMultiAreaRedistribution.two_areas()
+        ps = prefix_state_with(
+            ("3", "1", PrefixEntry(prefix=PFX)),
+            ("1", "0", PrefixEntry(prefix=PFX)),
+        )
+        db2 = routes("2", areas, ps)
+        route = db2.unicast_routes[PFX]
+        assert nh_names(route) == {"1"}
+        pol = policy(
+            RibPolicyStatementConfig(
+                name="consumer-side",
+                prefixes=[PFX],
+                set_weight=RibRouteActionWeight(
+                    default_weight=1, area_to_weight={"0": 8}
+                ),
+            )
+        )
+        assert pol.apply_policy(db2.unicast_routes).updated_routes == [PFX]
+        assert weights_by_neighbor(route) == {"1": 8}
+
+
 class TestStaticOverlayEdges:
     """Ancestors: static-route handling in buildRouteDb
     (Decision.cpp:427-449 createRouteForPrefixOrGetStaticRoute,

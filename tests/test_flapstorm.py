@@ -47,7 +47,11 @@ class TestFlapStormScenario:
         # 250 events per chunk collapse into one delta rebuild each
         assert result.delta_updates + result.delta_noops == result.chunks
 
-    def test_same_seed_replays_bit_for_bit(self, storm):
+    def test_same_seed_replays_bit_for_bit(self, storm, cpu_burner):
+        # the replay runs under the shared CPU burner (tests/conftest.py):
+        # a contended box must still produce the exact event log the
+        # uncontended original run did — any scheduling dependence in the
+        # storm's coalescing or dispatch accounting diverges the streams
         _, log = storm
         relog = ChaosEventLog()
         FlapStormScenario(seed=7, log_=relog).run()

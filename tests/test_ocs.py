@@ -22,7 +22,6 @@ from __future__ import annotations
 
 import os
 import random
-import threading
 
 import pytest
 
@@ -174,27 +173,16 @@ class TestOcsDaemonRewires:
 
 @pytest.mark.slow
 class TestOcsSoak:
-    def test_randomized_rewire_soak_under_cpu_burn(self):
-        """The daemon-level rewire loop on a loaded box: CPU burners
-        steal cycles so scenario waits only pass through the hold-based
-        convergence gate, never a lucky instantaneous poll."""
+    def test_randomized_rewire_soak_under_cpu_burn(self, cpu_burner):
+        """The daemon-level rewire loop on a loaded box: the shared CPU
+        burners (tests/conftest.py) steal cycles so scenario waits only
+        pass through the hold-based convergence gate, never a lucky
+        instantaneous poll."""
         seed = int(
             os.environ.get(
                 "OPENR_OCS_SEED", random.SystemRandom().randrange(2**31)
             )
         )
-        stop = threading.Event()
-
-        def burn():
-            x = 1
-            while not stop.is_set():
-                x = (x * 1103515245 + 12345) % (1 << 31)
-
-        burners = [
-            threading.Thread(target=burn, daemon=True) for _ in range(2)
-        ]
-        for b in burners:
-            b.start()
         try:
             log, ok, tables, oracle = run_daemon_rewires(seed, rounds=4)
             assert ok, log.scenario()
@@ -207,7 +195,3 @@ class TestOcsSoak:
             raise AssertionError(
                 f"ocs soak failed; replay with OPENR_OCS_SEED={seed}: {exc}"
             ) from exc
-        finally:
-            stop.set()
-            for b in burners:
-                b.join(timeout=5)

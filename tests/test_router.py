@@ -1,0 +1,342 @@
+"""ReplicaRouter unit tests over hand-controlled fake replicas.
+
+Every test drives the router through the same handle protocol real
+replicas use (submit/epoch/get_counters) with failure modes flipped by
+hand, so the routing decisions — round-robin spread, epoch pinning,
+failover, hedging, loud sheds — are asserted without any engine in the
+loop.  The fleet-with-real-schedulers path lives in
+tests/test_replicafleet.py.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import time
+
+import pytest
+
+from openr_tpu.device.engine import EpochMismatchError
+from openr_tpu.serving import (
+    QueryResult,
+    QueryShedError,
+    ReplicaRouter,
+    ReplicaUnavailableError,
+    ROUTER_COUNTER_KEYS,
+)
+
+
+class FakeReplica:
+    """Handle whose behavior is a mode flag: ok | shed | unavailable |
+    sync_raise | hold (futures parked for manual resolution)."""
+
+    def __init__(self, name: str, epoch: int = 1, mode: str = "ok") -> None:
+        self.name = name
+        self.epoch_value = epoch
+        self.mode = mode
+        self.submits: list = []
+        self.held: list = []
+
+    def submit(self, op: str, **kw) -> "concurrent.futures.Future":
+        if self.mode == "sync_raise":
+            raise ReplicaUnavailableError(f"{self.name} down hard")
+        self.submits.append((op, kw))
+        fut: "concurrent.futures.Future" = concurrent.futures.Future()
+        if self.mode == "ok":
+            fut.set_result(self._result())
+        elif self.mode == "shed":
+            fut.set_exception(QueryShedError(f"{self.name} overloaded"))
+        elif self.mode == "unavailable":
+            fut.set_exception(ReplicaUnavailableError(f"{self.name} down"))
+        else:  # hold
+            self.held.append(fut)
+        return fut
+
+    def _result(self) -> QueryResult:
+        return QueryResult(
+            value={"from": self.name},
+            latency_us=1,
+            batch_size=1,
+            epoch=self.epoch_value,
+        )
+
+    def release(self) -> None:
+        for fut in self.held:
+            if not fut.done():
+                fut.set_result(self._result())
+        self.held = []
+
+    def epoch(self, area: str = "0") -> int:
+        if self.mode in ("unavailable", "sync_raise"):
+            raise ReplicaUnavailableError(f"{self.name} down")
+        return self.epoch_value
+
+    def get_counters(self) -> dict:
+        return {"serving.admitted": 1, "serving.p99_us": 100}
+
+
+def make_router(reps, **kw):
+    kw.setdefault("hedge_after_s", None)  # hedging off unless the test asks
+    router = ReplicaRouter(reps, **kw)
+    return router
+
+
+def ledger_redispatches(c: dict) -> int:
+    return (
+        c["serving.router.retries"]
+        + c["serving.router.hedges"]
+        + c["serving.router.failovers"]
+        + c["serving.router.epoch_reroutes"]
+    )
+
+
+def assert_ledger(router: ReplicaRouter, submitted: int) -> None:
+    c = router.get_counters()
+    assert c["serving.router.dispatches"] == (
+        submitted - c["serving.router.sheds"]
+    ) + ledger_redispatches(c), c
+
+
+class TestDispatchSpread:
+    def test_round_robin_across_replicas(self):
+        reps = [FakeReplica(f"r{i}") for i in range(3)]
+        router = make_router(reps)
+        for _ in range(6):
+            assert router.submit("paths", sources=("a",)).result(5)
+        assert [len(r.submits) for r in reps] == [2, 2, 2]
+        c = router.get_counters()
+        assert c["serving.router.dispatches"] == 6
+        assert_ledger(router, 6)
+        router.stop()
+
+    def test_counter_rollup_sums_replicas_and_maxes_gauges(self):
+        reps = [FakeReplica("a"), FakeReplica("b")]
+        router = make_router(reps)
+        c = router.get_counters()
+        assert c["serving.admitted"] == 2  # summed across replicas
+        assert c["serving.p99_us"] == 100  # gauge: max, not sum
+        for key in ROUTER_COUNTER_KEYS:
+            assert key in c  # pre-seeded: dumpable before first bump
+        router.stop()
+
+    def test_all_router_keys_preseeded_at_zero(self):
+        router = make_router([FakeReplica("a")])
+        assert set(ROUTER_COUNTER_KEYS) <= set(router.counters)
+        assert all(router.counters[k] == 0 for k in ROUTER_COUNTER_KEYS)
+        router.stop()
+
+
+class TestFailover:
+    def test_async_unavailable_fails_over_and_marks_death(self):
+        down, up = FakeReplica("down", mode="unavailable"), FakeReplica("up")
+        router = make_router([down, up])
+        res = router.submit("paths", sources=("a",)).result(5)
+        assert res.value["from"] == "up"
+        c = router.get_counters()
+        assert c["serving.router.failovers"] == 1
+        assert c["serving.router.replica_deaths"] == 1
+        assert c["serving.router.dispatches"] == 2
+        assert router.alive_replicas() == 1
+        assert_ledger(router, 1)
+        router.stop()
+
+    def test_sync_refusal_is_not_a_ledger_dispatch(self):
+        hard, up = FakeReplica("hard", mode="sync_raise"), FakeReplica("up")
+        router = make_router([hard, up])
+        res = router.submit("paths", sources=("a",)).result(5)
+        assert res.value["from"] == "up"
+        c = router.get_counters()
+        # the refusing replica never received a dispatch: death recorded,
+        # ledger untouched
+        assert c["serving.router.dispatches"] == 1
+        assert c["serving.router.failovers"] == 0
+        assert c["serving.router.replica_deaths"] == 1
+        assert_ledger(router, 1)
+        router.stop()
+
+    def test_probe_revives_a_healed_replica(self):
+        rep = FakeReplica("r", mode="unavailable")
+        router = make_router([rep], initial_backoff_s=0.005)
+        assert router.probe_replicas() == 0
+        c = router.get_counters()
+        assert c["serving.router.probe_failures"] >= 1
+        assert c["serving.router.replica_deaths"] == 1
+        rep.mode = "ok"
+        time.sleep(0.02)  # let the backoff window expire
+        assert router.probe_replicas() == 1
+        assert router.alive_replicas() == 1
+        router.stop()
+
+
+class TestRetriesAndSheds:
+    def test_replica_shed_retries_on_another(self):
+        shedding, up = FakeReplica("shedding", mode="shed"), FakeReplica("up")
+        router = make_router([shedding, up])
+        res = router.submit("paths", sources=("a",)).result(5)
+        assert res.value["from"] == "up"
+        c = router.get_counters()
+        assert c["serving.router.retries"] == 1
+        assert c["serving.router.failovers"] == 0  # overload, not death
+        assert router.alive_replicas() == 2
+        assert_ledger(router, 1)
+        router.stop()
+
+    def test_fleetwide_shed_propagates_loudly(self):
+        reps = [FakeReplica(f"r{i}", mode="shed") for i in range(2)]
+        router = make_router(reps)
+        fut = router.submit("paths", sources=("a",))
+        with pytest.raises(QueryShedError):
+            fut.result(5)
+        # dispatched at least once, so this is the replicas' shed, not
+        # the router's own admission shed
+        c = router.get_counters()
+        assert c["serving.router.sheds"] == 0
+        assert c["serving.router.dispatches"] >= 1
+        assert_ledger(router, 1)
+        router.stop()
+
+    def test_no_replicas_sheds_at_admission(self):
+        router = make_router([])
+        fut = router.submit("paths", sources=("a",))
+        with pytest.raises(QueryShedError):
+            fut.result(5)
+        c = router.get_counters()
+        assert c["serving.router.sheds"] == 1
+        assert c["serving.router.dispatches"] == 0
+        assert_ledger(router, 1)
+        router.stop()
+
+    def test_stopped_router_sheds_at_admission(self):
+        router = make_router([FakeReplica("r")])
+        router.stop()
+        fut = router.submit("paths", sources=("a",))
+        with pytest.raises(QueryShedError):
+            fut.result(5)
+        assert router.get_counters()["serving.router.sheds"] == 1
+
+
+class TestEpochPinning:
+    def test_stale_reply_reroutes_to_caught_up_replica(self):
+        ahead = FakeReplica("ahead", epoch=5)
+        behind = FakeReplica("behind", epoch=3)
+        router = make_router([ahead, behind])
+        router.pin_trace = []
+        # first query pins the session at the ahead replica's epoch
+        res = router.submit("paths", sources=("a",), session="s").result(5)
+        assert res.epoch == 5
+        assert router.session_pin("s") == 5
+        # round-robin hands the next query to the behind replica: its
+        # stale answer must be re-routed, never delivered
+        res = router.submit("paths", sources=("a",), session="s").result(5)
+        assert res.epoch == 5
+        assert res.value["from"] == "ahead"
+        c = router.get_counters()
+        assert c["serving.router.epoch_reroutes"] == 1
+        epochs = [e for (s, e) in router.pin_trace if s == "s"]
+        assert epochs == sorted(epochs)  # monotonically non-decreasing
+        assert_ledger(router, 2)
+        router.stop()
+
+    def test_stale_answer_never_delivered_even_without_caught_up_peer(self):
+        ahead = FakeReplica("ahead", epoch=5)
+        behind = FakeReplica("behind", epoch=3)
+        router = make_router([ahead, behind], max_attempts=4)
+        assert (
+            router.submit("paths", sources=("a",), session="s").result(5).epoch
+            == 5
+        )
+        ahead.mode = "unavailable"  # only the behind replica remains
+        fut = router.submit("paths", sources=("a",), session="s")
+        # bounded re-routes exhaust and fail loudly — a stale answer is
+        # never the fallback
+        with pytest.raises(Exception) as exc_info:
+            fut.result(5)
+        assert not isinstance(exc_info.value, concurrent.futures.TimeoutError)
+        assert router.session_pin("s") == 5
+        router.stop()
+
+    def test_sessionless_queries_have_no_pin(self):
+        behind = FakeReplica("behind", epoch=3)
+        router = make_router([behind])
+        res = router.submit("paths", sources=("a",)).result(5)
+        assert res.epoch == 3
+        assert router.get_counters()["serving.router.epoch_reroutes"] == 0
+        router.stop()
+
+    def test_pin_only_moves_forward(self):
+        rep = FakeReplica("r", epoch=5)
+        router = make_router([rep])
+        router.submit("paths", sources=("a",), session="s").result(5)
+        rep.epoch_value = 9
+        router.submit("paths", sources=("a",), session="s").result(5)
+        assert router.session_pin("s") == 9
+        router.stop()
+
+
+class TestHedging:
+    def test_hedge_wins_when_primary_stalls(self):
+        slow = FakeReplica("slow", mode="hold")
+        fast = FakeReplica("fast")
+        router = ReplicaRouter([slow, fast], hedge_after_s=0.01)
+        res = router.submit("paths", sources=("a",)).result(10)
+        assert res.value["from"] == "fast"
+        c = router.get_counters()
+        assert c["serving.router.hedges"] == 1
+        assert c["serving.router.hedge_wins"] == 1
+        assert_ledger(router, 1)
+        # the loser resolves late: observed for health, answer dropped
+        slow.release()
+        time.sleep(0.02)
+        assert router.alive_replicas() == 2
+        assert_ledger(router, 1)
+        router.stop()
+
+    def test_no_hedge_when_reply_beats_deadline(self):
+        reps = [FakeReplica("a"), FakeReplica("b")]
+        router = ReplicaRouter(reps, hedge_after_s=5.0)
+        assert router.submit("paths", sources=("x",)).result(5)
+        time.sleep(0.02)
+        assert router.get_counters()["serving.router.hedges"] == 0
+        router.stop()
+
+
+class TestEpochMismatchRetry:
+    def test_mismatch_from_replica_is_retried_not_failed(self):
+        class MismatchOnce(FakeReplica):
+            def __init__(self):
+                super().__init__("flappy", epoch=2)
+                self.first = True
+
+            def submit(self, op, **kw):
+                if self.first:
+                    self.first = False
+                    fut = concurrent.futures.Future()
+                    fut.set_exception(EpochMismatchError(1, 2))
+                    self.submits.append((op, kw))
+                    return fut
+                return super().submit(op, **kw)
+
+        router = make_router([MismatchOnce()])
+        res = router.submit("paths", sources=("a",)).result(5)
+        assert res.epoch == 2
+        c = router.get_counters()
+        assert c["serving.router.retries"] == 1
+        assert c["serving.router.replica_deaths"] == 0  # healthy, just moved
+        assert_ledger(router, 1)
+        router.stop()
+
+
+class TestLoadGenIntegration:
+    def test_open_loop_ledger_reconciles_over_fakes(self):
+        from openr_tpu.chaos import OpenLoopLoadGen
+
+        reps = [FakeReplica(f"r{i}", epoch=4) for i in range(3)]
+        router = make_router(reps)
+        gen = OpenLoopLoadGen(
+            router, ["a", "b", "c"], seed=3, clients=4, sessions=True
+        )
+        report = gen.run_burst(25)
+        assert report.submitted == 100
+        assert report.accounted == report.submitted
+        assert report.replied == 100
+        assert_ledger(router, report.submitted)
+        router.stop()

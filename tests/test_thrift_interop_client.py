@@ -11,9 +11,14 @@ openr/if/Types.thrift:555 Value, :647 KeySetParams, :897 Publication),
 and imports NOTHING from openr_tpu — if our shim drifts from the
 thrift binary protocol, this client stops parsing it.
 
-The container has no `thrift` pip package, so the runtime classes are
-vendored here verbatim in shape (method names, envelope bytes, framing)
-rather than imported; only the server-side fixture touches openr_tpu.
+The runtime classes are vendored here verbatim in shape (method names,
+envelope bytes, framing) so the suite runs even where the `thrift` pip
+package is absent; only the server-side fixture touches openr_tpu.
+Every test is additionally parametrized over the REAL Apache `thrift`
+runtime (TSocket / TFramedTransport / TBinaryProtocol from the pip
+package) when it is importable — that leg skips cleanly otherwise — so
+an environment that does carry the stock runtime proves the shim
+against the canonical implementation, not just our vendored copy.
 """
 
 from __future__ import annotations
@@ -320,6 +325,67 @@ class TBinaryProtocol:
             self.readListEnd()
         else:
             raise TTransportException(f"cannot skip type {ttype}")
+
+
+# ---------------------------------------------------------------------------
+# Runtime seam: every test runs over the vendored stack above AND (when
+# the pip package is importable) the real Apache `thrift` runtime.
+# ---------------------------------------------------------------------------
+
+
+class _ApacheProtocolAdapter:
+    """Byte-level readString/writeString over the real runtime's protocol.
+
+    The Apache Python runtime decodes strings at the protocol layer
+    (readString -> str via readBinary); the generated slice in this file
+    keeps T_STRING payloads as bytes and decodes at the field site, like
+    a binary-typed field.  The adapter pins that convention on top of
+    the stock protocol so the SAME generated classes drive both stacks —
+    everything below readString/writeString (envelope, framing, varints,
+    field headers) is the real runtime's encoding.
+    """
+
+    def __init__(self, proto):
+        self._proto = proto
+        self.trans = proto.trans
+
+    def __getattr__(self, name):
+        return getattr(self._proto, name)
+
+    def readString(self):
+        return self._proto.readBinary()
+
+    def writeString(self, s):
+        if isinstance(s, str):
+            s = s.encode()
+        self._proto.writeBinary(s)
+
+
+def make_client_stack(runtime, host, port):
+    """(transport, protocol) for the requested client runtime."""
+    if runtime == "vendored":
+        transport = TFramedTransport(TSocket(host, port))
+        return transport, TBinaryProtocol(transport)
+    assert runtime == "apache"
+    from thrift.protocol import TBinaryProtocol as ApacheBinaryProtocol
+    from thrift.transport import TSocket as ApacheSocket
+    from thrift.transport import TTransport as ApacheTransport
+
+    sock = ApacheSocket.TSocket(host, port)
+    sock.setTimeout(10000)
+    transport = ApacheTransport.TFramedTransport(sock)
+    protocol = ApacheBinaryProtocol.TBinaryProtocol(transport)
+    return transport, _ApacheProtocolAdapter(protocol)
+
+
+@pytest.fixture(params=["vendored", "apache"])
+def client_runtime(request):
+    if request.param == "apache":
+        pytest.importorskip(
+            "thrift",
+            reason="real apache thrift pip runtime not installed",
+        )
+    return request.param
 
 
 # ---------------------------------------------------------------------------
@@ -877,15 +943,14 @@ class TestGeneratedClientInterop:
         srv.wait_until_stopped(5)
         daemon.stop()
 
-    def _client(self, port):
-        transport = TFramedTransport(TSocket("::1", port))
-        protocol = TBinaryProtocol(transport)
+    def _client(self, runtime, port):
+        transport, protocol = make_client_stack(runtime, "::1", port)
         transport.open()
         return transport, OpenrCtrlClient(protocol)
 
-    def test_set_then_get_roundtrip(self, shim):
+    def test_set_then_get_roundtrip(self, shim, client_runtime):
         daemon, srv = shim
-        transport, client = self._client(srv.port)
+        transport, client = self._client(client_runtime, srv.port)
         try:
             client.setKvStoreKeyVals(
                 KeySetParams_(
@@ -915,18 +980,20 @@ class TestGeneratedClientInterop:
         finally:
             transport.close()
 
-    def test_get_missing_key_is_empty_publication(self, shim):
+    def test_get_missing_key_is_empty_publication(self, shim, client_runtime):
         _daemon, srv = shim
-        transport, client = self._client(srv.port)
+        transport, client = self._client(client_runtime, srv.port)
         try:
             out = client.getKvStoreKeyVals(["interop:no-such-key"])
             assert out.keyVals == {}
         finally:
             transport.close()
 
-    def test_unknown_method_raises_application_exception(self, shim):
+    def test_unknown_method_raises_application_exception(
+        self, shim, client_runtime
+    ):
         _daemon, srv = shim
-        transport, client = self._client(srv.port)
+        transport, client = self._client(client_runtime, srv.port)
         try:
             with pytest.raises(TApplicationException):
                 client.getUnsupportedThing()
@@ -996,14 +1063,15 @@ class TestGeneratedClientRoutesAndCounters:
         for d in daemons:
             d.stop()
 
-    def _client(self, port):
-        transport = TFramedTransport(TSocket("::1", port))
-        protocol = TBinaryProtocol(transport)
+    def _client(self, runtime, port):
+        transport, protocol = make_client_stack(runtime, "::1", port)
         transport.open()
         return transport, OpenrCtrlClient(protocol)
 
-    def test_route_dump_parses_to_converged_tables(self, pair):
-        transport, client = self._client(pair[0].thrift_shim.port)
+    def test_route_dump_parses_to_converged_tables(self, pair, client_runtime):
+        transport, client = self._client(
+            client_runtime, pair[0].thrift_shim.port
+        )
         try:
             db = client.getRouteDb()
             assert db.thisNodeName == "genc-0"
@@ -1016,8 +1084,10 @@ class TestGeneratedClientRoutesAndCounters:
         finally:
             transport.close()
 
-    def test_route_dump_computed_any_node(self, pair):
-        transport, client = self._client(pair[0].thrift_shim.port)
+    def test_route_dump_computed_any_node(self, pair, client_runtime):
+        transport, client = self._client(
+            client_runtime, pair[0].thrift_shim.port
+        )
         try:
             db = client.getRouteDbComputed("genc-1")
             assert db.thisNodeName == "genc-1"
@@ -1029,8 +1099,10 @@ class TestGeneratedClientRoutesAndCounters:
         finally:
             transport.close()
 
-    def test_fb303_counters_include_rewire_family(self, pair):
-        transport, client = self._client(pair[0].thrift_shim.port)
+    def test_fb303_counters_include_rewire_family(self, pair, client_runtime):
+        transport, client = self._client(
+            client_runtime, pair[0].thrift_shim.port
+        )
         try:
             counters = client.getCounters()
             missing = [k for k in REWIRE_COUNTER_KEYS if k not in counters]
