@@ -920,7 +920,16 @@ def bench_flap_storm_wan100k(
     work_ratio = delta_sweep_cols / (chunks * cold_sweeps * n_prefixes)
     assert work_ratio < 0.05, f"delta_work_ratio regressed: {work_ratio}"
     storm_ms = min(sum(times_a), sum(times_b))
-    return {
+    # traffic model for the storm's relax work: each relax block makes 4
+    # sweeps over the pb-column slab (read+write), each chunk writes the
+    # slab's bitmap once and the frontier pass reads the full dist once
+    itemsize = 2 if small else 4
+    bytes_storm = (
+        2 * delta_sweep_cols * n * itemsize
+        + sum(s["pb"] for s in stats) * n * out.n_words * 4
+        + chunks * n * n_prefixes * itemsize
+    )
+    return _attach_bw({
         "topology": topo.name,
         "n_nodes": n,
         "n_prefix_destinations": n_prefixes,
@@ -944,8 +953,6 @@ def bench_flap_storm_wan100k(
         "overflow_fallbacks": engine.counters[
             "device.engine.delta_overflow_fallbacks"
         ],
-        "bytes_moved_est": None,
-        "achieved_bw_frac": None,
         "note": (
             "every chunk's product asserted bit-exact against a cold "
             "host-oracle rebuild of that chunk's topology before the "
@@ -955,7 +962,7 @@ def bench_flap_storm_wan100k(
             "live pass and a rolled-product warm replay (distinct bytes "
             "per dispatch, replay-guard discipline)."
         ),
-    }
+    }, bytes_storm, storm_ms)
 
 
 def bench_ocs_rewire_wan100k(
@@ -1088,7 +1095,10 @@ def bench_ocs_rewire_wan100k(
     else:
         shed_note = (shed_note or "") + "; budget: skipped cold sweep"
 
-    return {
+    # utilization lens on the rewire rung itself: H2D bytes the masked
+    # writes staged over the engine-side staging wall (rewire_us)
+    rewire_ms = c["device.engine.rewire_us"] / 1e3
+    return _attach_bw({
         "topology": f"wan{n // 1000}k-ocs-ring",
         "n_nodes": n,
         "rounds": done_rounds,
@@ -1116,18 +1126,226 @@ def bench_ocs_rewire_wan100k(
         "ls_build_s": round(ls_build_s, 1),
         "csr_build_s": round(csr_build_s, 1),
         "cold_sweep_exact": exact,
-        "bytes_moved_est": None,
-        "achieved_bw_frac": None,
         "note": (
             "restage_vs_rewire_bytes is the headline: H2D bytes a full "
             "re-upload costs per byte the masked-write rewire rung "
             "stages for one bounded circuit swap.  round_ms includes "
             "the host-side LinkState->CSR refresh (identity diff + slot "
             "freelist patch), not just device time; rewire_us is the "
-            "engine-side staging alone."
+            "engine-side staging alone (also the achieved_bw_frac wall)."
             + (f"  {shed_note}" if shed_note else "")
         ),
+    }, rewire_bytes, rewire_ms)
+
+
+def bench_pallas_vs_xla(reps: int = 5) -> dict:
+    """Round-14 Pallas rung: both hand-tiled kernels (fused
+    verify+bitmap epilogue, blocked rank-B outer update) against XLA
+    twins of the same fused math on identical inputs, with the roofline
+    column.  Bytes prefer the compiled program's own cost_analysis()
+    over the traffic model (bytes_source records which); peak_bw_source
+    records the roofline denominator's provenance so rows compare
+    across machines.  Off-TPU the kernels run in the interpreter, whose
+    wall measures the interpreter loop, not the hardware — `mode`
+    disambiguates."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from benchmarks.util import achieved_bw_frac, peak_bw_source
+    from openr_tpu.ops import pallas_kernels as pk
+    import openr_tpu.parallel.blocked as blk
+
+    mode = pk.pallas_mode()
+    if mode == "off":
+        # the bench row forces the kernels on; policy-off machines still
+        # get a comparison, in interpreter mode
+        mode = "compiled" if jax.default_backend() == "tpu" else "interpret"
+    interp = mode == "interpret"
+    rng = np.random.default_rng(14)
+
+    def _cost_bytes(lowerable, *args, **kwargs):
+        """cost_analysis 'bytes accessed' of the compiled program, or
+        None when the backend/version doesn't expose it."""
+        try:
+            ca = lowerable.lower(*args, **kwargs).compile().cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            v = ca.get("bytes accessed") if hasattr(ca, "get") else None
+            return float(v) if v and v > 0 else None
+        except Exception:
+            return None
+
+    # -- kernel 1: fused verify+bitmap epilogue ---------------------------
+    n, p, g, n_words = 1024, 512, 8, 1
+    d_h = rng.integers(0, 2000, size=(n, p)).astype(np.uint16)
+    d_h[rng.random((n, p)) < 0.1] = pk._INF16  # unreached entries
+    d = jnp.asarray(d_h)
+    idx = jnp.asarray(rng.integers(0, n, size=(g, n)), dtype=jnp.int32)
+    w = jnp.asarray(rng.integers(1, 100, size=(g, n)), dtype=jnp.int32)
+    ov = jnp.asarray(rng.random((g, n)) < 0.05, dtype=jnp.int32)
+    slot = jnp.asarray(
+        np.where(
+            rng.random((g, n)) < 0.05,
+            -1,
+            rng.integers(0, 32 * n_words, size=(g, n)),
+        ),
+        dtype=jnp.int32,
+    )
+
+    @jax.jit
+    def epi_xla(d, idx, w, ov, slot):
+        # generic-lax twin of the fused epilogue: same math, no tiling
+        inf = jnp.asarray(pk._INF16, d.dtype)
+        fin = d < inf
+        du = jnp.take(d, idx, axis=0)  # [G, N, P]
+        allow = (w < pk._WBIG16)[:, :, None] & ((ov == 0)[:, :, None] | (du == 0))
+        cand = jnp.where(
+            allow & (du < inf), du + w.astype(d.dtype)[:, :, None], inf
+        )
+        on = fin[None] & (cand == d[None])
+        bits = jnp.where(
+            slot >= 0,
+            jnp.uint32(1) << jnp.maximum(slot, 0).astype(jnp.uint32) % 32,
+            jnp.uint32(0),
+        )
+        contrib = jnp.where(on, bits[:, :, None], jnp.uint32(0))
+        bitmap = lax.reduce(
+            contrib, np.uint32(0), lax.bitwise_or, dimensions=(0,)
+        )
+        vmin = jnp.minimum(d, cand.min(axis=0))
+        return bitmap, vmin
+
+    epi_pallas = functools.partial(
+        pk.fused_epilogue_pallas, n_groups=g, n_words=n_words,
+        interpret=interp,
+    )
+    epi_pallas_ms = min(_time_device(
+        lambda: epi_pallas(d, idx, w, ov, slot), reps=reps, warmup=1
+    ))
+    epi_xla_ms = min(_time_device(
+        lambda: epi_xla(d, idx, w, ov, slot), reps=reps, warmup=1
+    ))
+    # bit-exactness spot check rides along (tier-1 owns the real sweep)
+    bm_p, vmin_p = epi_pallas(d, idx, w, ov, slot)
+    bm_x, vmin_x = epi_xla(d, idx, w, ov, slot)
+    assert bool(jnp.all(bm_p[0] == bm_x)) and bool(jnp.all(vmin_p == vmin_x))
+    # traffic model: d read + vmin written per tile pass, bitmap written,
+    # the four group tables re-read per 128-wide column tile
+    epi_tm = (
+        2 * n * p * d_h.itemsize
+        + n_words * n * p * 4
+        + (p // 128) * 4 * g * n * 4
+    )
+    epi_bytes, epi_src = epi_tm, "traffic_model"
+    if not interp:
+        cb = _cost_bytes(
+            pk.fused_epilogue_pallas, d, idx, w, ov, slot,
+            n_groups=g, n_words=n_words, interpret=False,
+        )
+        if cb:
+            epi_bytes, epi_src = cb, "cost_analysis"
+    epi_xla_bytes = _cost_bytes(epi_xla, d, idx, w, ov, slot) or epi_tm
+
+    # -- kernel 2: blocked rank-B outer update ----------------------------
+    s, t, b = 1, 8, 128
+    np_ = t * b
+    k = 3
+    dist_h = rng.integers(0, 1 << 20, size=(s, t, b, t, b)).astype(np.uint32)
+    row_p = jnp.asarray(
+        rng.integers(0, 1 << 20, size=(s, b, t, b)).astype(np.uint32)
+    )
+    col_p = jnp.asarray(
+        rng.integers(0, 1 << 20, size=(s, t, b, b)).astype(np.uint32)
+    )
+    ov_n = jnp.asarray(rng.random(np_) < 0.05)
+    mesh = blk.make_blocked_mesh(jax.devices()[:1])
+    xla_outer = jax.jit(
+        lambda dd, rp, cp, o, kk: blk.blocked_outer(
+            dd, rp, cp, o, kk, mesh=mesh
+        )
+    )
+    # blocked_outer_pallas donates dist: rotate pre-staged copies so no
+    # rep re-submits a deleted buffer (and no rep dispatches twice on
+    # identical bytes — replay-guard discipline)
+    staged = [jax.device_put(dist_h) for _ in range(reps + 2)]
+    jax.block_until_ready(staged)
+    it = iter(staged)
+    blk_pallas_ms = min(_time_device(
+        lambda: pk.blocked_outer_pallas(
+            next(it), row_p, col_p, ov_n, k, interpret=interp
+        ),
+        reps=reps, warmup=1,
+    ))
+    dist0 = jax.device_put(dist_h)
+    blk_xla_ms = min(_time_device(
+        lambda: xla_outer(dist0, row_p, col_p, ov_n, k), reps=reps, warmup=1
+    ))
+    out_p = pk.blocked_outer_pallas(
+        jax.device_put(dist_h), row_p, col_p, ov_n, k, interpret=interp
+    )
+    assert bool(jnp.all(out_p == xla_outer(dist0, row_p, col_p, ov_n, k)))
+    # traffic model: dist read+written once; each panel re-read per tile
+    # row/column of the grid
+    blk_tm = 2 * s * np_ * np_ * 4 + 2 * t * s * np_ * b * 4
+    blk_bytes, blk_src = blk_tm, "traffic_model"
+    if not interp:
+        cb = _cost_bytes(
+            pk.blocked_outer_pallas,
+            jax.ShapeDtypeStruct(dist_h.shape, jnp.uint32),
+            row_p, col_p, ov_n, k, interpret=False,
+        )
+        if cb:
+            blk_bytes, blk_src = cb, "cost_analysis"
+    blk_xla_bytes = _cost_bytes(
+        xla_outer, jax.ShapeDtypeStruct(dist_h.shape, jnp.uint32),
+        row_p, col_p, ov_n, k,
+    ) or blk_tm
+
+    row = {
+        "scenario": (
+            "hand-tiled Pallas kernels vs generic-XLA twins of the same "
+            "fused math, identical inputs, bit-exactness asserted"
+        ),
+        "mode": mode,
+        "backend": jax.default_backend(),
+        "peak_bw_source": peak_bw_source(),
+        "fused_epilogue": {
+            "n_nodes": n, "n_prefixes": p, "groups": g,
+            "pallas_ms": round(epi_pallas_ms, 3),
+            "xla_ms": round(epi_xla_ms, 3),
+            "speedup_vs_xla": round(epi_xla_ms / epi_pallas_ms, 2),
+            "bytes_moved": int(epi_bytes),
+            "bytes_source": epi_src,
+            "achieved_bw_frac": achieved_bw_frac(epi_bytes, epi_pallas_ms),
+            "xla_achieved_bw_frac": achieved_bw_frac(
+                epi_xla_bytes, epi_xla_ms
+            ),
+        },
+        "blocked_outer": {
+            "tiles": [s, t, b],
+            "pallas_ms": round(blk_pallas_ms, 3),
+            "xla_ms": round(blk_xla_ms, 3),
+            "speedup_vs_xla": round(blk_xla_ms / blk_pallas_ms, 2),
+            "bytes_moved": int(blk_bytes),
+            "bytes_source": blk_src,
+            "achieved_bw_frac": achieved_bw_frac(blk_bytes, blk_pallas_ms),
+            "xla_achieved_bw_frac": achieved_bw_frac(
+                blk_xla_bytes, blk_xla_ms
+            ),
+        },
+        "note": (
+            "per-kernel sub-rows; achieved_bw_frac under mode=interpret "
+            "times the Pallas interpreter loop, not the hardware — only "
+            "compiled-mode fractions are roofline statements (the slow-"
+            "gated device test asserts those).  XLA twins materialize "
+            "the [G,N,P] candidate tensor the fused kernel never writes."
+        ),
     }
+    # headline utilization columns for the uniform device-row surface
+    return _attach_bw(row, epi_bytes, epi_pallas_ms)
 
 
 def bench_ksp_dual_metric_wan100k(topo, n_dests: int = 8) -> dict:
@@ -2338,6 +2556,9 @@ DEVICE_ROWS = {
     # round-11 OCS circuit swaps: slot-freelist rewires vs full restage
     # byte economics on one resident graph (builds its own LinkState)
     "ocs_rewire_wan100k": lambda t: bench_ocs_rewire_wan100k(),
+    # round-14 Pallas kernels vs their XLA twins, roofline column per
+    # kernel (compiled on TPU; interpreter elsewhere, labeled)
+    "pallas_vs_xla": lambda t: bench_pallas_vs_xla(),
     # BASELINE config #3: dual-metric KSP at 100k (r3 next #6)
     "ksp_dual_metric_wan100k": lambda t: bench_ksp_dual_metric_wan100k(
         t.wan
@@ -2397,6 +2618,11 @@ DEVICE_NOTES = [
     "row (bytes_moved_est null).  A memory-bound kernel near 1.0 is "
     "done; a small fraction says the wall is dispatch/latency, not "
     "bandwidth",
+    "pallas_vs_xla carries per-kernel sub-rows (fused_epilogue, "
+    "blocked_outer) with their own bytes_source — compiled-program "
+    "cost_analysis when available, traffic model otherwise — and "
+    "peak_bw_source so roofline fractions compare across machines; "
+    "mode=interpret rows time the Pallas interpreter, not the hardware",
 ]
 
 

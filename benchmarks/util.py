@@ -26,6 +26,14 @@ def achieved_bw_frac(
     return round(float(bytes_moved) / (wall_ms * 1e-3 * PEAK_HBM_BW), 4)
 
 
+def peak_bw_source() -> str:
+    """Provenance of the PEAK_HBM_BW figure used by achieved_bw_frac:
+    "env" when the operator pinned OPENR_PEAK_HBM_BW, "default_v5e"
+    otherwise.  Recorded next to roofline fractions so a row compared
+    across machines says which denominator it was computed against."""
+    return "env" if os.environ.get("OPENR_PEAK_HBM_BW") else "default_v5e"
+
+
 def measure_ms(fn: Callable[[], None], reps: int = 3, warmup: int = 1) -> float:
     for _ in range(warmup):
         fn()

@@ -70,6 +70,14 @@ ENGINE_COUNTER_KEYS = (
     "device.engine.rewire_bytes_staged",
     "device.engine.rewire_us",
     "device.engine.rewire_fallbacks",
+    # Pallas kernel rung (ops.pallas_kernels): launches that ran the
+    # hand-tiled kernels, demotions to the XLA path, and policy-off
+    # skips.  Pre-seeded like every family so both wire surfaces dump
+    # the keys before the first dispatch.
+    "device.engine.pallas_products",
+    "device.engine.pallas_outer_updates",
+    "device.engine.pallas_fallbacks",
+    "device.engine.pallas_skips",
 )
 
 # affected-column padding ladder for the delta rung: a frontier of
@@ -303,6 +311,11 @@ class DeviceResidencyEngine:
         self._delta_buckets_seen: set = set()
         # chaos seam: called with an op name at every engine entry point
         self.fault_hook: Optional[Callable[[str], None]] = None
+        # Pallas policy override: None resolves the OPENR_PALLAS env
+        # knob (ops.pallas_kernels.pallas_mode); tests and the program
+        # auditor pin "interpret"/"off" here instead of mutating the
+        # environment (the _drive_blocked threshold discipline)
+        self.pallas_mode: Optional[str] = None
         # third dispatch rung (delta < fused full < blocked): node-axis
         # sharded blocked APSP (parallel.blocked).  Eagerly constructed
         # so its pre-seeded mesh.blocked.* counters dump before the
@@ -737,6 +750,24 @@ class DeviceResidencyEngine:
                 "device.engine.dispatch_us",
                 int((time.perf_counter() - t0) * 1e6),
             )
+
+    def run_pallas(self, kind: str, pallas_thunk, xla_thunk):
+        """Engine face of the Pallas demotion contract
+        (ops.pallas_kernels.run_with_fallback): binds this engine's
+        counter and chaos seams so every launch, demotion and skip is
+        accounted under `device.engine.pallas_*`, and an armed
+        `engine:pallas` chaos fault demotes through the same path a
+        real Pallas failure takes."""
+        from ..ops import pallas_kernels as pk
+
+        return pk.run_with_fallback(
+            kind,
+            pallas_thunk,
+            xla_thunk,
+            counters=self.counters,
+            fault_hook=self.fault_hook,
+            mode=self.pallas_mode,
+        )
 
     # -- delta rung ----------------------------------------------------------
 

@@ -254,6 +254,16 @@ def blocked_outer(dist, row_p, col_p, node_overloaded, k, *, mesh: Mesh):
     return lax.with_sharding_constraint(dist, s_dist)
 
 
+def _outer_pallas_thunk(dist, row_p, col_p, ov, k, interpret: bool):
+    """Phase-3 pallas thunk in the run_with_fallback calling shape
+    (trailing `interpret` bound by the demotion policy)."""
+    from ..ops import pallas_kernels as pk
+
+    return pk.blocked_outer_pallas(
+        dist, row_p, col_p, ov, k, interpret=interpret
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("n", "mesh"))
 def blocked_extract(dist, tile_id, lane_id, *, n: int, mesh: Mesh):
     """[N, P] int32 destination columns of the S=0 slice: drev[v, p] =
@@ -457,6 +467,17 @@ class BlockedApspEngine:
             + b * n_pad * (cols - 1) // max(cols, 1)
             + b * b
         )
+        # Pallas phase-3 rung (ops.pallas_kernels.blocked_outer_pallas):
+        # single-device meshes only — the kernel is not shard_map'd, so
+        # launching it on a sharded tile tensor would all-gather the
+        # matrix.  The parent engine owns the policy, the
+        # device.engine.pallas_* accounting and the chaos seam; a
+        # standalone rung (no parent) always takes the XLA phase.
+        run_pallas = (
+            getattr(self._parent, "run_pallas", None)
+            if mesh.devices.size == 1
+            else None
+        )
         for k in range(t):
             self._hook("blocked_round")
             kk = jnp.int32(k)
@@ -465,7 +486,22 @@ class BlockedApspEngine:
             t1 = time.monotonic_ns()
             row_p, col_p = blocked_panels(dist, closed, ov, kk, mesh=mesh)
             t2 = time.monotonic_ns()
-            dist = blocked_outer(dist, row_p, col_p, ov, kk, mesh=mesh)
+            if run_pallas is not None:
+                # every demotion trigger raises at/before trace time
+                # (pallas_kernels.blocked_outer_pallas docstring), so
+                # the donated dist is still intact for the XLA thunk
+                dist = run_pallas(
+                    "outer",
+                    functools.partial(
+                        _outer_pallas_thunk, dist, row_p, col_p, ov, kk
+                    ),
+                    functools.partial(
+                        blocked_outer, dist, row_p, col_p, ov, kk,
+                        mesh=mesh,
+                    ),
+                )
+            else:
+                dist = blocked_outer(dist, row_p, col_p, ov, kk, mesh=mesh)
             t3 = time.monotonic_ns()
             self._bump("mesh.blocked.tile_updates")
             self._bump("mesh.blocked.panel_broadcasts", 2)

@@ -838,6 +838,124 @@ class TestBestRouteSelectionChain:
         assert all(nh.metric == 20 for nh in route.nexthops)
 
 
+class TestDuplicatePrefixTieBreaksPersistentPair:
+    """Ancestors: DecisionTestFixture.DuplicatePrefixes (:6267) +
+    Decision.BestRouteSelection (:1139), the tie-break ordering cases —
+    ported onto ONE persistent dual-backend solver pair (the PR-5
+    harness): every advertise/withdraw step rebuilds on the same host
+    and device solvers and asserts route parity AND identical
+    best-route cache verdicts, so the selection state machine (not a
+    fresh solver's first impression) is what's proven."""
+
+    @staticmethod
+    def entry(pp=1000, sp=100, dist=0):
+        return PrefixEntry(
+            prefix=PFX,
+            metrics=PrefixMetrics(
+                path_preference=pp, source_preference=sp, distance=dist
+            ),
+        )
+
+    def test_metric_tie_breaks_to_lowest_originator(self):
+        ls = square()
+        ps = PrefixState()
+        host = SpfSolver("1", enable_best_route_selection=True)
+        device = SpfSolver(
+            "1",
+            spf_backend=DeviceSpfBackend(
+                min_device_nodes=1, min_device_sources=1
+            ),
+            enable_best_route_selection=True,
+        )
+        steps = 0
+
+        def check():
+            nonlocal steps
+            steps += 1
+            h = host.build_route_db({"0": ls}, ps)
+            d = device.build_route_db({"0": ls}, ps)
+            assert h.unicast_routes == d.unicast_routes, steps
+            assert h.mpls_routes == d.mpls_routes, steps
+            hb = host.best_routes_cache.get(PFX)
+            db_ = device.best_routes_cache.get(PFX)
+            if hb is None or db_ is None:
+                assert hb is None and db_ is None, steps
+                return h, None
+            assert hb.best_node_area == db_.best_node_area, steps
+            assert hb.all_node_areas == db_.all_node_areas, steps
+            return h, hb
+
+        # 1: full metric tie between 2 and 3 — both kept (ECMP), the
+        # representative advertiser is the LOWEST originator
+        ps.update_prefix("2", "0", self.entry())
+        ps.update_prefix("3", "0", self.entry())
+        db, best = check()
+        assert nh_names(db.unicast_routes[PFX]) == {"2", "3"}
+        assert best.best_node_area == ("2", "0")
+        assert best.all_node_areas == {("2", "0"), ("3", "0")}
+
+        # 2: a third tied advertiser joins; selection keeps all three,
+        # the originator tie-break is unmoved, and forwarding still
+        # points at the nearest advertisers only
+        ps.update_prefix("4", "0", self.entry())
+        db, best = check()
+        assert best.best_node_area == ("2", "0")
+        assert best.all_node_areas == {("2", "0"), ("3", "0"), ("4", "0")}
+        assert nh_names(db.unicast_routes[PFX]) == {"2", "3"}
+
+        # 3: the lowest originator withdraws — tie re-breaks to the next
+        # lowest, on the same solver pair
+        ps.delete_prefix("2", "0", PFX)
+        db, best = check()
+        assert best.best_node_area == ("3", "0")
+        assert best.all_node_areas == {("3", "0"), ("4", "0")}
+
+        # 4: distance ASC beats originator order: "3" readvertises with
+        # a worse (higher) distance, so "4" wins alone despite being
+        # lexicographically higher
+        ps.update_prefix("3", "0", self.entry(dist=2))
+        db, best = check()
+        assert best.best_node_area == ("4", "0")
+        assert best.all_node_areas == {("4", "0")}
+        assert nh_names(db.unicast_routes[PFX]) == {"2", "3"}  # to 4
+
+        # 5: path_preference dominates the whole chain — "3" comes back
+        # with higher pp and takes the route from "4" outright
+        ps.update_prefix("3", "0", self.entry(pp=2000, dist=2))
+        db, best = check()
+        assert best.best_node_area == ("3", "0")
+        assert best.all_node_areas == {("3", "0")}
+        assert nh_names(db.unicast_routes[PFX]) == {"3"}
+
+        # 6: restore the exact tie — selection converges back to the
+        # lowest-originator verdict, bit-identical on both backends
+        ps.update_prefix("3", "0", self.entry())
+        ps.update_prefix("4", "0", self.entry())
+        db, best = check()
+        assert best.best_node_area == ("3", "0")
+        assert best.all_node_areas == {("3", "0"), ("4", "0")}
+        # forwarding follows the nearest advertiser (3 at 10, 4 at 20)
+        assert nh_names(db.unicast_routes[PFX]) == {"3"}
+        assert steps == 6
+
+    def test_source_preference_tie_still_breaks_by_originator(self):
+        """sp ties at a non-default value must NOT shadow the
+        originator rule: equal (pp, sp, distance) keeps the set and
+        the lowest advertiser as representative."""
+        ls = square()
+        ps = prefix_state_with(
+            ("3", "0", self.entry(sp=500)),
+            ("4", "0", self.entry(sp=500)),
+        )
+        db = routes("1", {"0": ls}, ps, enable_best_route_selection=True)
+        assert nh_names(db.unicast_routes[PFX]) == {"3"}
+        host = SpfSolver("1", enable_best_route_selection=True)
+        host.build_route_db({"0": ls}, ps)
+        best = host.best_routes_cache[PFX]
+        assert best.best_node_area == ("3", "0")
+        assert best.all_node_areas == {("3", "0"), ("4", "0")}
+
+
 class TestOrderedFibHolds:
     """Ancestor: the ordered-FIB hold machinery (HoldableValue,
     LinkState.cpp decrementHolds + DecisionTest hold coverage): route
