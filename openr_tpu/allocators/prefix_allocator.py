@@ -163,8 +163,13 @@ class PrefixAllocator:
                 from ..nl.netlink import NetlinkProtocolSocket
 
                 # one cached socket: per-sync construction would leak
-                # the persistent request fd to GC under churn
-                self._nl = NetlinkProtocolSocket()
+                # the persistent request fd to GC under churn.  Bare write
+                # is single-drainer-confined: _apply_iface_addr runs only
+                # on the one live worker (the _addr_worker_busy handshake
+                # under _addr_sync_lock serializes successive workers, and
+                # stop()'s locked reclaim at the loop head sees the update
+                # through that same lock).
+                self._nl = NetlinkProtocolSocket()  # openr: disable=guarded-by
             nl = self._nl
             if_index = {
                 l.if_name: l.if_index for l in nl.get_all_links()

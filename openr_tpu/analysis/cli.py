@@ -22,13 +22,23 @@ cross-referencing and suppression audit are whole-tree properties, and
 scoping the *analysis* would fabricate false positives (a counter seeded
 in a changed file but bumped in an unchanged one).  Program rules are
 whole-program by construction, so their findings always survive the
-filter.
+filter; so do the lock-discipline families (``lock-order``,
+``guarded-by``, ``thread-shutdown-order``) — a cycle through the
+whole-tree lock graph or a shutdown-order hole can anchor to an
+unchanged file that an edit elsewhere just made reachable.
+
+``--races <pytest expr...>`` arms the OPENR_TSAN dynamic happens-before
+detector (analysis/race.py) and runs the given pytest expressions in a
+subprocess; the tsan_guard fixture fails any test whose run produced an
+unsuppressed race, so the usual exit-code contract holds (0 clean,
+1 findings, 2 infra failure).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import subprocess
 import sys
 from pathlib import Path
@@ -63,6 +73,27 @@ def _changed_files(root: Path) -> set[str]:
         path = path.strip().strip('"')
         changed.add(Path(path).as_posix())
     return changed
+
+
+def _run_races(exprs: list[str]) -> int:
+    """Arm OPENR_TSAN and run pytest over `exprs` in a subprocess (the
+    detector monkeypatches threading/futures — that must happen in a fresh
+    interpreter, before the tests' objects exist)."""
+    env = dict(os.environ)
+    env["OPENR_TSAN"] = "1"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = [sys.executable, "-m", "pytest", "-q", *exprs]
+    try:
+        proc = subprocess.run(cmd, env=env)
+    except OSError as e:
+        print(f"error: --races could not launch pytest: {e}", file=sys.stderr)
+        return 2
+    if proc.returncode == 0:
+        return 0
+    if proc.returncode == 1:
+        return 1  # test failures, incl. tsan_guard race findings
+    # collection error, usage error, interrupted, ... -> infra failure
+    return 2
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -113,10 +144,24 @@ def main(argv: list[str] | None = None) -> int:
         action="store_true",
         help=(
             "report AST findings only for files touched in the git working "
-            "tree; program-* findings are always whole-tree"
+            "tree; program-* and lock-discipline findings are always "
+            "whole-tree"
+        ),
+    )
+    parser.add_argument(
+        "--races",
+        nargs="+",
+        metavar="TEST_EXPR",
+        help=(
+            "run the given pytest expressions under OPENR_TSAN=1 (dynamic "
+            "happens-before race detection); any unsuppressed race fails "
+            "the run with exit code 1"
         ),
     )
     args = parser.parse_args(argv)
+
+    if args.races:
+        return _run_races(args.races)
 
     if args.list_rules:
         for rule, desc in sorted(ALL_RULES.items()):
@@ -154,10 +199,12 @@ def main(argv: list[str] | None = None) -> int:
         # seeded in serving/ but orphaned by an edit elsewhere) — the
         # serving layer's SLO counters must never be filtered out of a
         # pre-commit pass
+        _WHOLE_TREE_RULES = {"lock-order", "guarded-by", "thread-shutdown-order"}
         findings = [
             f
             for f in findings
             if f.rule.startswith("program-")
+            or f.rule in _WHOLE_TREE_RULES
             or f.path in changed
             or (f.rule.startswith("counter-") and "serving." in f.message)
         ]

@@ -179,6 +179,18 @@ ALL_RULES: dict[str, str] = {
     "thread-queue-registration": (
         "ReplicateQueue created in the daemon but absent from the named-queue dict"
     ),
+    "lock-order": (
+        "lock acquisition order inconsistent across the whole-tree lock "
+        "graph (cycle: a deadlock is one unlucky schedule away)"
+    ),
+    "guarded-by": (
+        "attribute written under a lock at one site and bare at another "
+        "(the lock protects nothing if any writer skips it)"
+    ),
+    "thread-shutdown-order": (
+        "consumer module stopped before its registered queue is closed "
+        "(stop() can wedge on a get() nobody will ever wake)"
+    ),
     # counter hygiene (openr_tpu/analysis/counters.py)
     "counter-name": "counter literal violates the module.name convention",
     "counter-registry": (
@@ -254,6 +266,11 @@ class AnalysisConfig:
     #: jaxpr (e.g. differentiable/loss kernels); everything else is integer
     #: min-plus arithmetic and any float is a promotion bug
     program_float_allowed: list[str] = field(default_factory=list)
+    #: dotted class paths the OPENR_TSAN dynamic race detector instruments
+    #: (openr_tpu/analysis/race.py); empty means its built-in defaults
+    tsan_tracked_paths: list[str] = field(default_factory=list)
+    #: `Class.attr` lock-graph nodes excluded from the lock-order rule
+    lock_order_exclude: list[str] = field(default_factory=list)
 
     def active_rules(self) -> set[str]:
         return {r for r in self.enable if r in ALL_RULES} - set(self.disable)
@@ -347,6 +364,8 @@ def load_config(start: Path) -> tuple[AnalysisConfig, Path]:
                     "counter_extra_prefixes",
                     "module_attrs",
                     "program_float_allowed",
+                    "tsan_tracked_paths",
+                    "lock_order_exclude",
                 ):
                     val = raw.get(key)
                     if isinstance(val, list):
@@ -502,7 +521,13 @@ def run_analysis(
 
         jit.check(files, reporter, config, root)
         executed |= active & jit_rules
-    thread_rules = {"thread-cross-module-write", "thread-queue-registration"}
+    thread_rules = {
+        "thread-cross-module-write",
+        "thread-queue-registration",
+        "lock-order",
+        "guarded-by",
+        "thread-shutdown-order",
+    }
     if active & thread_rules:
         from . import threads
 

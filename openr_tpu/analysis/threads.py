@@ -16,6 +16,24 @@ through ``RWQueue`` / ``ReplicateQueue`` streams or the ctrl handler's
   ``self._queues`` dict — that dict is the introspection surface
   (``queue.<name>.*`` counters, drain-on-shutdown, chaos hooks); an
   unregistered queue is invisible to all three.
+
+Three lock-discipline rules back the OPENR_TSAN dynamic detector
+(``analysis/race.py``) with whole-tree static evidence:
+
+- ``lock-order``: build the whole-tree lock graph (node = ``Class.attr``
+  of a ``self.X = Lock()/RLock()/Condition()`` site; ``Condition(self._y)``
+  aliases to ``_y``'s node; edge = inner acquisition while an outer is
+  held) and flag every edge that sits on a cycle — an inconsistent
+  acquisition order is a deadlock waiting for one unlucky schedule.
+  ``lock_order_exclude`` in config drops known-hierarchical nodes.
+- ``guarded-by``: within one class, an attribute written under
+  ``with self.<lock>`` at one site and bare at another (outside
+  ``__init__``) — the lock protects nothing if any writer skips it.
+- ``thread-shutdown-order``: in classes carrying the ``self._queues``
+  registry, every queue with a consumer (a module constructed with
+  ``self.Q.get_reader()``) must be closed in ``stop()`` *before* that
+  consumer's ``stop()`` — otherwise shutdown can wedge on a ``get()``
+  nobody will ever wake.  Today only convention enforces this ordering.
 """
 
 from __future__ import annotations
@@ -41,6 +59,12 @@ DEFAULT_MODULE_ATTRS = [
     "netlink",
     "watchdog",
     "serving",
+    # post-PR-13 serving surface: the coalescing scheduler, the replica
+    # front door, and the fleet's replica handles / front-door handler
+    "scheduler",
+    "router",
+    "handler",
+    "daemons",
 ]
 
 
@@ -59,10 +83,15 @@ def check(
     root: Path,
 ) -> None:
     module_attrs = set(config.module_attrs or DEFAULT_MODULE_ATTRS)
+    lock_edges: list[tuple[str, str, SourceFile, ast.AST]] = []
     for sf in files:
         _check_cross_module_writes(sf, reporter, module_attrs)
         # self-gates on the presence of a `self._queues = {...}` registry
         _check_queue_registration(sf, reporter)
+        _check_guarded_by(sf, reporter)
+        _check_shutdown_order(sf, reporter)
+        lock_edges.extend(_collect_lock_edges(sf))
+    _check_lock_order(lock_edges, reporter, set(config.lock_order_exclude))
 
 
 def _check_cross_module_writes(
@@ -197,3 +226,384 @@ def _call_class_name(call: ast.Call) -> str | None:
     if isinstance(f, ast.Attribute):
         return f.attr
     return None
+
+
+# ---------------------------------------------------------------------------
+# Lock-discipline rules (static companions to the OPENR_TSAN detector)
+# ---------------------------------------------------------------------------
+
+_LOCK_CLASSES = {"Lock", "RLock", "Condition"}
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """`self.X` -> `X`, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _class_lock_attrs(cls: ast.ClassDef) -> dict[str, str]:
+    """Lock-holding attrs of a class: {attr: canonical attr}.  A
+    ``Condition(self._y)`` shares ``_y``'s underlying lock, so its attr
+    aliases to ``_y``'s node in the lock graph."""
+    locks: dict[str, str] = {}
+    aliases: dict[str, str] = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        value = node.value
+        if not isinstance(value, ast.Call):
+            continue
+        name = _call_class_name(value)
+        if name not in _LOCK_CLASSES:
+            continue
+        for tgt in node.targets:
+            attr = _self_attr(tgt)
+            if attr is None:
+                continue
+            locks[attr] = attr
+            if name == "Condition" and value.args:
+                inner = _self_attr(value.args[0])
+                if inner is not None:
+                    aliases[attr] = inner
+    for attr, inner in aliases.items():
+        if inner in locks:
+            locks[attr] = inner
+    return locks
+
+
+def _iter_class_functions(cls: ast.ClassDef):
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _collect_lock_edges(
+    sf: SourceFile,
+) -> list[tuple[str, str, SourceFile, ast.AST]]:
+    """Whole-tree lock-graph edges for one file: (held_node, inner_node,
+    file, site) for every acquisition of `inner` while `held` is held.
+    Node names are `Class.attr` with Condition aliasing applied."""
+    edges: list[tuple[str, str, SourceFile, ast.AST]] = []
+    for cls in ast.walk(sf.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        locks = _class_lock_attrs(cls)
+        if not locks:
+            continue
+
+        def node_name(attr: str) -> str:
+            return f"{cls.name}.{locks[attr]}"
+
+        def walk(node: ast.AST, held: list[str]) -> None:
+            if isinstance(node, ast.With):
+                acquired: list[str] = []
+                for item in node.items:
+                    ctx = item.context_expr
+                    attr = _self_attr(ctx)
+                    if attr is not None and attr in locks:
+                        # `with self._a, self._b:` acquires _b while _a
+                        # is already held — same edge as nesting
+                        _edge(attr, node, held + acquired)
+                        acquired.append(attr)
+                for child in node.body:
+                    walk(child, held + acquired)
+                return
+            if isinstance(node, ast.Call):
+                # explicit self.X.acquire() while something is held: edge
+                # only (scope of the manual hold is not tracked)
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr == "acquire":
+                    attr = _self_attr(f.value)
+                    if attr is not None and attr in locks:
+                        _edge(attr, node, held)
+            for child in ast.iter_child_nodes(node):
+                walk(child, held)
+
+        def _edge(attr: str, site: ast.AST, held: list[str]) -> None:
+            inner = node_name(attr)
+            for h in held:
+                outer = node_name(h)
+                if outer != inner:
+                    edges.append((outer, inner, sf, site))
+
+        for fn in _iter_class_functions(cls):
+            walk(fn, [])
+    return edges
+
+
+def _check_lock_order(
+    edges: list[tuple[str, str, SourceFile, ast.AST]],
+    reporter: Reporter,
+    exclude: set[str],
+) -> None:
+    edges = [
+        (a, b, sf, site)
+        for (a, b, sf, site) in edges
+        if a not in exclude and b not in exclude
+    ]
+    adj: dict[str, set[str]] = {}
+    for a, b, _sf, _site in edges:
+        adj.setdefault(a, set()).add(b)
+
+    def reaches(src: str, dst: str) -> bool:
+        seen = {src}
+        stack = [src]
+        while stack:
+            cur = stack.pop()
+            if cur == dst:
+                return True
+            for nxt in adj.get(cur, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append(nxt)
+        return False
+
+    reverse_sites: dict[tuple[str, str], tuple[SourceFile, ast.AST]] = {}
+    for a, b, sf, site in edges:
+        reverse_sites.setdefault((a, b), (sf, site))
+    for a, b, sf, site in edges:
+        if not reaches(b, a):
+            continue
+        counter = reverse_sites.get((b, a))
+        if counter is not None:
+            csf, csite = counter
+            where = f"{csf.rel}:{getattr(csite, 'lineno', '?')}"
+            detail = f"the reverse order `{b}` -> `{a}` is taken at {where}"
+        else:
+            detail = (
+                f"`{b}` reaches back to `{a}` through the whole-tree lock "
+                "graph"
+            )
+        reporter.emit(
+            sf,
+            "lock-order",
+            site,
+            f"lock `{b}` acquired while holding `{a}`, but {detail}; "
+            "inconsistent acquisition order deadlocks on the schedule "
+            "where both threads hold their first lock",
+        )
+
+
+def _check_guarded_by(sf: SourceFile, reporter: Reporter) -> None:
+    for cls in ast.walk(sf.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        locks = _class_lock_attrs(cls)
+        if not locks:
+            continue
+        # attr -> list of (held_locks_at_write, site)
+        writes: dict[str, list[tuple[frozenset[str], ast.AST]]] = {}
+
+        def walk(node: ast.AST, held: frozenset[str]) -> None:
+            if isinstance(node, ast.With):
+                acquired = set()
+                for item in node.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr is not None and attr in locks:
+                        acquired.add(locks[attr])
+                for child in node.body:
+                    walk(child, held | acquired)
+                return
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for tgt in targets:
+                    # Subscript writes (counters["x"] = 1) mutate through a
+                    # stable container reference, not the attribute binding
+                    attr = _self_attr(tgt)
+                    if attr is not None and attr not in locks:
+                        writes.setdefault(attr, []).append((held, node))
+            for child in ast.iter_child_nodes(node):
+                walk(child, held)
+
+        for fn in _iter_class_functions(cls):
+            if fn.name == "__init__":
+                continue  # construction happens-before every other thread
+            walk(fn, frozenset())
+
+        for attr, sites in sorted(writes.items()):
+            guarded = [s for s in sites if s[0]]
+            bare = [s for s in sites if not s[0]]
+            if not guarded or not bare:
+                continue
+            glocks = sorted(guarded[0][0])
+            gline = getattr(guarded[0][1], "lineno", "?")
+            for _held, node in bare:
+                reporter.emit(
+                    sf,
+                    "guarded-by",
+                    node,
+                    f"`self.{attr}` written bare here but under "
+                    f"`{'`/`'.join(glocks)}` at line {gline}; a lock only "
+                    "protects state if every writer takes it",
+                )
+
+
+def _check_shutdown_order(sf: SourceFile, reporter: Reporter) -> None:
+    """Queues in the `self._queues` registry must close before the modules
+    consuming them stop."""
+    for cls in ast.walk(sf.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        registered = _registered_queue_attrs(cls)
+        if not registered:
+            continue
+        consumers = _queue_consumers(cls, registered)
+        if not consumers:
+            continue
+        stop_fn = next(
+            (f for f in _iter_class_functions(cls) if f.name == "stop"), None
+        )
+        if stop_fn is None:
+            continue
+        close_lines, stop_lines = _stop_method_events(cls, stop_fn, registered)
+        for module, (queues, _site) in sorted(consumers.items()):
+            mod_stop = stop_lines.get(module)
+            if mod_stop is None:
+                continue
+            for q in sorted(queues):
+                q_close = close_lines.get(q)
+                if q_close is None:
+                    reporter.emit(
+                        sf,
+                        "thread-shutdown-order",
+                        (mod_stop, 0),
+                        f"`self.{module}.stop()` but its input queue "
+                        f"`self.{q}` is never closed in stop(); the "
+                        "consumer can wedge on a get() nobody will wake",
+                    )
+                elif q_close > mod_stop:
+                    reporter.emit(
+                        sf,
+                        "thread-shutdown-order",
+                        (mod_stop, 0),
+                        f"`self.{module}.stop()` runs before `self.{q}` "
+                        f"closes (line {q_close}); close/drain the queue "
+                        "first so the consumer's final get() returns",
+                    )
+
+
+def _registered_queue_attrs(cls: ast.ClassDef) -> set[str]:
+    registered: set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        value = node.value
+        if value is None or not isinstance(value, ast.Dict):
+            continue
+        for tgt in targets:
+            if _self_attr(tgt) == "_queues":
+                for v in value.values:
+                    attr = _self_attr(v)
+                    if attr is not None:
+                        registered.add(attr)
+    return registered
+
+
+def _queue_consumers(
+    cls: ast.ClassDef, registered: set[str]
+) -> dict[str, tuple[set[str], ast.AST]]:
+    """Modules constructed with a `self.Q.get_reader()` argument:
+    {module_attr: ({queue_attrs}, construction site)}."""
+    consumers: dict[str, tuple[set[str], ast.AST]] = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        value = node.value
+        if not isinstance(value, ast.Call):
+            continue
+        queues: set[str] = set()
+        for sub in ast.walk(value):
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "get_reader"
+            ):
+                qattr = _self_attr(sub.func.value)
+                if qattr is not None and qattr in registered:
+                    queues.add(qattr)
+        if not queues:
+            continue
+        for tgt in node.targets:
+            mattr = _self_attr(tgt)
+            if mattr is not None:
+                prev = consumers.get(mattr)
+                if prev is not None:
+                    prev[0].update(queues)
+                else:
+                    consumers[mattr] = (queues, node)
+    return consumers
+
+
+def _stop_method_events(
+    cls: ast.ClassDef, stop_fn: ast.AST, registered: set[str]
+) -> tuple[dict[str, int], dict[str, int]]:
+    """(queue close lines, module stop lines) observed in stop().
+
+    Recognizes the close-all loop `for q in self._queues.values():
+    q.close()` (closes every registered queue at that line), per-queue
+    `self.Q.close()`, direct `self.M.stop()`, and the gather-then-stop
+    idiom `modules = [self.A, ...]` + `for m in modules: m.stop()`."""
+    close_lines: dict[str, int] = {}
+    stop_lines: dict[str, int] = {}
+    # Name -> list of self-attrs it holds (list-literal resolution)
+    list_vars: dict[str, list[str]] = {}
+    for node in ast.walk(stop_fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.List):
+            attrs = [
+                a
+                for a in (_self_attr(el) for el in node.value.elts)
+                if a is not None
+            ]
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and attrs:
+                    list_vars[tgt.id] = attrs
+        if isinstance(node, ast.For):
+            loop_attrs: list[str] | None = None
+            close_all = False
+            it = node.iter
+            if (
+                isinstance(it, ast.Call)
+                and isinstance(it.func, ast.Attribute)
+                and it.func.attr == "values"
+                and _self_attr(it.func.value) == "_queues"
+            ):
+                close_all = True
+            elif isinstance(it, ast.Name) and it.id in list_vars:
+                loop_attrs = list_vars[it.id]
+            if close_all or loop_attrs is not None:
+                var = node.target.id if isinstance(node.target, ast.Name) else None
+                for sub in ast.walk(node):
+                    if not (
+                        isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and isinstance(sub.func.value, ast.Name)
+                        and sub.func.value.id == var
+                    ):
+                        continue
+                    if close_all and sub.func.attr == "close":
+                        for q in registered:
+                            close_lines.setdefault(q, node.lineno)
+                    if loop_attrs is not None and sub.func.attr == "stop":
+                        for m in loop_attrs:
+                            stop_lines.setdefault(m, node.lineno)
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            owner = _self_attr(node.func.value)
+            if owner is None:
+                continue
+            if node.func.attr == "close" and owner in registered:
+                close_lines.setdefault(owner, node.lineno)
+            if node.func.attr == "stop":
+                stop_lines.setdefault(owner, node.lineno)
+    return close_lines, stop_lines

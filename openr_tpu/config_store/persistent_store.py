@@ -147,7 +147,9 @@ class PersistentStore:
                 if f.tell() == 0:
                     f.write(TLV_MARKER)
                 f.write(encode_persistent_object(obj))
-            self.num_writes_to_disk += 1
+            # guarded by the caller: set()/erase() enter _append inside
+            # `with self._lock` (RLock), so this increment never runs bare
+            self.num_writes_to_disk += 1  # openr: disable=guarded-by
         except OSError:
             # _db already holds the mutation; the next full rewrite
             # reconciles the file

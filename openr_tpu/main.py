@@ -33,6 +33,7 @@ import signal
 import threading
 from typing import Optional
 
+from .analysis import race as _race
 from .config import OpenrConfig, load_config
 from .ctrl import CtrlServer, OpenrCtrlHandler, TcpKvStoreTransport
 from .decision.decision import Decision
@@ -67,6 +68,9 @@ class OpenrDaemon:
         ctrl_port: Optional[int] = None,
         spark_v6_addr: str = "",
     ) -> None:
+        # OPENR_TSAN=1 arms the happens-before race detector before any
+        # module object exists (no-op otherwise; docs/OPERATIONS.md)
+        _race.maybe_enable()
         self.config = config
         name = config.node_name
         areas = config.area_ids

@@ -16,7 +16,17 @@ import threading
 import time
 from typing import Any, Awaitable, Callable, Coroutine, Optional
 
+from ..analysis import race as _race
+
 log = logging.getLogger(__name__)
+
+
+def _tsan_handoff(fn: Callable[..., Any]) -> Callable[..., Any]:
+    """OPENR_TSAN: wrap a closure about to be marshalled to another thread
+    (call_soon_threadsafe and friends) with a happens-before handoff edge.
+    Identity when disarmed — a single module-attribute load."""
+    det = _race.TSAN
+    return fn if det is None else det.wrap_handoff(fn)
 
 
 class Timeout:
@@ -136,7 +146,7 @@ class OpenrEventBase:
             self._loop.create_task(_graceful())
 
         try:
-            self._loop.call_soon_threadsafe(_do_stop)
+            self._loop.call_soon_threadsafe(_tsan_handoff(_do_stop))
         except RuntimeError:
             return
         # Joining from the module's own loop thread would deadlock (the loop
@@ -183,7 +193,7 @@ class OpenrEventBase:
         def _create() -> None:
             self._track(self._loop.create_task(coro, name=name or "fiber"))
 
-        self._loop.call_soon_threadsafe(_create)
+        self._loop.call_soon_threadsafe(_tsan_handoff(_create))
 
     def in_event_base_thread(self) -> bool:
         return threading.current_thread() is self._thread
@@ -213,17 +223,21 @@ class OpenrEventBase:
             except BaseException as e:  # noqa: BLE001
                 fut.set_exception(e)
 
-        self._loop.call_soon_threadsafe(_call)
+        self._loop.call_soon_threadsafe(_tsan_handoff(_call))
         return fut
 
     async def run_async(self, coro: Awaitable[Any]) -> Any:
         """Await a coroutine on this module's loop from another loop/thread."""
-        assert self._loop is not None
-        cfut = asyncio.run_coroutine_threadsafe(coro, self._loop)
-        return await asyncio.wrap_future(cfut)
+        return await asyncio.wrap_future(self.run_coroutine(coro))
 
     def run_coroutine(self, coro: Awaitable[Any]) -> "concurrent.futures.Future[Any]":
         assert self._loop is not None
+        det = _race.TSAN
+        if det is not None:
+            # forward edge: caller -> coroutine body on the module loop.
+            # The return edge needs no wrap — wrap_future/result() observe
+            # the patched Future resolve token.
+            coro = det.wrap_coro(coro)
         return asyncio.run_coroutine_threadsafe(coro, self._loop)
 
     def schedule_timeout(self, delay_s: float, fn: Callable[[], Any]) -> "Timeout":
@@ -231,7 +245,9 @@ class OpenrEventBase:
         cancellable token (Spark-style hold timers reset constantly)."""
         assert self._loop is not None
         token = Timeout()
-        self._loop.call_soon_threadsafe(token._arm, self._loop, delay_s, fn)
+        self._loop.call_soon_threadsafe(
+            _tsan_handoff(token._arm), self._loop, delay_s, _tsan_handoff(fn)
+        )
         return token
 
     # -- watchdog interface (reference: getTimestamp, OpenrEventBase.h:74) --

@@ -22,6 +22,8 @@ import threading
 from collections import deque
 from typing import Any, Callable, Generic, Iterable, Optional, TypeVar
 
+from ..analysis import race as _race
+
 T = TypeVar("T")
 
 
@@ -76,6 +78,10 @@ class RWQueue(Generic[T]):
         self._num_pushed = 0
         self._num_read = 0
         self._num_overflows = 0
+        # OPENR_TSAN: per-item HB tokens mirroring _items (put -> matching
+        # get).  None until the detector is first armed; kept positionally
+        # aligned under _lock.
+        self._tsan_tokens: Optional[deque] = None
 
     # -- write side ---------------------------------------------------------
 
@@ -84,13 +90,24 @@ class RWQueue(Generic[T]):
         with self._lock:
             if self._closed:
                 return False
+            det = _race.TSAN
+            if det is not None:
+                toks = self._tsan_tokens
+                if toks is None or len(toks) != len(self._items):
+                    # first armed push, or items enqueued while disarmed:
+                    # realign with null tokens (no HB claimed for those)
+                    toks = self._tsan_tokens = deque([None] * len(self._items))
             if self._maxlen is not None and len(self._items) >= self._maxlen:
                 # bounded queue: shed the OLDEST item (routing deltas are
                 # superseded by later state; blocking the producer would
                 # wedge the pushing module's event base instead)
                 shed = self._items.popleft()
                 self._num_overflows += 1
+                if det is not None:
+                    toks.popleft()
             self._items.append(item)
+            if det is not None:
+                toks.append(det.publish_token())
             self._num_pushed += 1
             self._cond.notify()
             waiters, self._async_waiters = self._async_waiters, []
@@ -122,6 +139,16 @@ class RWQueue(Generic[T]):
 
     # -- read side ----------------------------------------------------------
 
+    def _tsan_join(self) -> None:
+        """OPENR_TSAN: join the head item's put token (called under _lock,
+        immediately before the matching _items.popleft())."""
+        toks = self._tsan_tokens
+        if toks is not None and len(toks) == len(self._items):
+            tok = toks.popleft()
+            det = _race.TSAN
+            if det is not None and tok is not None:
+                det.acquire_token(tok)
+
     def get(self, timeout: Optional[float] = None) -> T:
         with self._cond:
             if not self._cond.wait_for(
@@ -130,6 +157,8 @@ class RWQueue(Generic[T]):
                 raise TimeoutError("queue get timed out")
             if self._items:
                 self._num_read += 1
+                if self._tsan_tokens is not None:
+                    self._tsan_join()
                 return self._items.popleft()
             raise QueueClosedError("queue closed")
 
@@ -137,6 +166,8 @@ class RWQueue(Generic[T]):
         with self._lock:
             if self._items:
                 self._num_read += 1
+                if self._tsan_tokens is not None:
+                    self._tsan_join()
                 return self._items.popleft()
             if self._closed:
                 raise QueueClosedError("queue closed")
@@ -148,6 +179,8 @@ class RWQueue(Generic[T]):
             with self._lock:
                 if self._items:
                     self._num_read += 1
+                    if self._tsan_tokens is not None:
+                        self._tsan_join()
                     return self._items.popleft()
                 if self._closed:
                     raise QueueClosedError("queue closed")

@@ -4,8 +4,11 @@
 Equivalent to ``python -m openr_tpu.analysis openr_tpu/`` from the repo
 root, but runnable from anywhere in the tree.  All CLI flags pass
 through — e.g. ``scripts/lint.py --changed-only`` for a fast pre-commit
-pass scoped to the files you touched, or ``scripts/lint.py --programs``
-for the full jaxpr-contract audit.
+pass scoped to the files you touched (lock-order / guarded-by /
+thread-shutdown-order findings always survive the filter: they are
+whole-tree properties), ``scripts/lint.py --programs`` for the full
+jaxpr-contract audit, or ``scripts/lint.py --races tests/test_chaos.py``
+to run tests under the OPENR_TSAN dynamic race detector.
 """
 
 import sys

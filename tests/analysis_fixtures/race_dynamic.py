@@ -1,0 +1,187 @@
+"""Runnable seeded-race scenarios for the OPENR_TSAN dynamic detector.
+
+tests/test_race.py loads this by path, registers `State` as a tracked
+class, runs each scenario with the detector armed, and asserts on the
+drained findings — including the exact source lines of the racing
+accesses, located via the ``# RACE-*`` markers so the assertions survive
+edits to this file.
+
+Scenarios deliberately avoid incidental synchronization (no Events, no
+joins before the racy access): under the armed detector those would
+create happens-before edges and hide the seeded race.
+"""
+
+import threading
+import time
+
+from openr_tpu.runtime.queue import RWQueue
+
+
+class State:
+    """Tracked fixture class: plain attribute storage."""
+
+    def __init__(self):
+        self.value = 0
+
+
+def bare_write_race():
+    """Two threads write the same attribute with no synchronization."""
+    state = State()
+
+    def writer_a():
+        state.value = 1  # RACE-A
+
+    def writer_b():
+        state.value = 2  # RACE-B
+
+    a = threading.Thread(target=writer_a, name="race-a")
+    b = threading.Thread(target=writer_b, name="race-b")
+    a.start()
+    b.start()
+    a.join()
+    b.join()
+
+
+def bare_read_race():
+    """An unsynchronized read against a concurrent write."""
+    state = State()
+    out = []
+
+    def reader():
+        out.append(state.value)  # RACE-READ
+
+    def writer():
+        state.value = 7  # RACE-WRITE
+
+    r = threading.Thread(target=reader, name="race-reader")
+    w = threading.Thread(target=writer, name="race-writer")
+    r.start()
+    w.start()
+    r.join()
+    w.join()
+
+
+def dedup_double_race():
+    """The same two code sites race over two distinct objects: the
+    detector dedups by site pair, so this must yield ONE finding."""
+    s1, s2 = State(), State()
+
+    def writer(tag):
+        for obj in (s1, s2):
+            obj.value = tag  # RACE-DEDUP
+
+    a = threading.Thread(target=writer, args=(1,), name="dedup-a")
+    b = threading.Thread(target=writer, args=(2,), name="dedup-b")
+    a.start()
+    b.start()
+    a.join()
+    b.join()
+
+
+def queue_handoff_clean():
+    """Producer writes, pushes; consumer gets, writes: the put->get edge
+    orders the writes.  Must stay silent."""
+    state = State()
+    q = RWQueue()
+
+    def producer():
+        state.value = 1
+        q.push("ready")
+
+    def consumer():
+        q.get(timeout=10)
+        state.value = 2
+
+    p = threading.Thread(target=producer, name="q-producer")
+    c = threading.Thread(target=consumer, name="q-consumer")
+    p.start()
+    c.start()
+    p.join()
+    c.join()
+
+
+def two_hop_relay_clean():
+    """Transitive HB: origin -> q1 -> relay -> q2 -> sink.  The sink's
+    write is ordered after the origin's only through two queue hops."""
+    state = State()
+    q1 = RWQueue()
+    q2 = RWQueue()
+
+    def origin():
+        state.value = 1
+        q1.push("hop")
+
+    def relay():
+        q1.get(timeout=10)
+        q2.push("hop")
+
+    def sink():
+        q2.get(timeout=10)
+        state.value = 2
+
+    threads = [
+        threading.Thread(target=fn, name=f"hop-{fn.__name__}")
+        for fn in (origin, relay, sink)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+def lock_protected_clean():
+    """Read-modify-write under one lock from two threads: every pair is
+    ordered by release->acquire edges.  Must stay silent."""
+    state = State()
+    mu = threading.Lock()
+
+    def flip():
+        for _ in range(50):
+            with mu:
+                state.value += 1
+
+    threads = [
+        threading.Thread(target=flip, name=f"flip-{i}") for i in range(2)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return state
+
+
+def token_ordered_clean(det):
+    """Explicit publish/acquire tokens order cross-thread writes; the
+    token rides a plain list (append is GIL-atomic, no hidden edge)."""
+    state = State()
+    box = []
+
+    def producer():
+        state.value = 1
+        box.append(det.publish_token())
+
+    t = threading.Thread(target=producer, name="token-producer")
+    t.start()
+    while not box:
+        time.sleep(0.001)
+    det.acquire_token(box[0])
+    state.value = 2  # ordered: acquire_token joined the producer's clock
+    t.join()
+
+
+def token_missing_race():
+    """Same shape as token_ordered_clean but nobody acquires the token:
+    the main-thread write must race the producer's."""
+    state = State()
+    box = []
+
+    def producer():
+        state.value = 1  # RACE-TOKEN-A
+        box.append(None)
+
+    t = threading.Thread(target=producer, name="token-producer")
+    t.start()
+    while not box:
+        time.sleep(0.001)
+    state.value = 2  # RACE-TOKEN-B
+    t.join()

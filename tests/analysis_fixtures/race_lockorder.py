@@ -1,0 +1,78 @@
+"""Seeded lock-discipline violations: lock-order and guarded-by.
+
+tests/test_race.py asserts exact (rule, line) pairs against this file —
+keep line numbers stable when editing.
+"""
+
+import threading
+
+
+class Inverted:
+    """A->B in one method and B->A in another: a 2-cycle in the lock graph."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def ab(self):
+        with self._a:
+            with self._b:  # lock-order: cycle with ba() below
+                pass
+
+    def ba(self):
+        with self._b:
+            with self._a:  # lock-order: reverse of ab() above
+                pass
+
+
+class Hierarchical:
+    """Consistent A->B everywhere, including the multi-item form: clean."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def nested(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def multi_item(self):
+        with self._a, self._b:
+            pass
+
+
+class HalfGuarded:
+    """`count` written under `_lock` in bump() but bare in reset()."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0  # __init__ writes happen-before every other thread
+
+    def bump(self):
+        with self._lock:
+            self.count += 1
+
+    def reset(self):
+        self.count = 0  # guarded-by: bare write
+
+    def reset_quiesced(self):
+        # single-threaded maintenance path, every worker already joined
+        self.count = -1  # openr: disable=guarded-by
+
+
+class CondAlias:
+    """Condition(self._mu) shares _mu's lock: same node, so taking one
+    inside the other is not a graph edge (and no self-cycle)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+
+    def signal(self):
+        with self._cv:
+            self.ready = True
+
+    def also_under_mu(self):
+        with self._mu:
+            self.ready = False

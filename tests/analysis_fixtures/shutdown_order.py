@@ -1,0 +1,39 @@
+"""Seeded thread-shutdown-order violations.
+
+tests/test_race.py asserts exact (rule, line) pairs against this file —
+keep line numbers stable when editing.  Names are resolved purely by
+shape (AST); the classes referenced here do not need to import.
+"""
+
+
+class BadDaemon:
+    """One consumer stops before its queue closes; another's queue is
+    never closed at all."""
+
+    def __init__(self):
+        self.updates = ReplicateQueue()  # noqa: F821
+        self.events = ReplicateQueue()  # noqa: F821
+        self._queues = {"updates": self.updates, "events": self.events}
+        self.decision = Decision(self.updates.get_reader())  # noqa: F821
+        self.fib = Fib(self.events.get_reader())  # noqa: F821
+
+    def stop(self):
+        self.decision.stop()  # stops before updates closes (line below)
+        self.updates.close()
+        self.fib.stop()  # events is never closed in stop()
+
+
+class GoodDaemon:
+    """Close-all loop, then the gather-then-stop idiom: clean."""
+
+    def __init__(self):
+        self.updates = ReplicateQueue()  # noqa: F821
+        self._queues = {"updates": self.updates}
+        self.decision = Decision(self.updates.get_reader())  # noqa: F821
+
+    def stop(self):
+        for q in self._queues.values():
+            q.close()
+        modules = [self.decision]
+        for m in modules:
+            m.stop()

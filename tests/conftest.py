@@ -35,6 +35,34 @@ import threading  # noqa: E402
 
 import pytest  # noqa: E402
 
+from openr_tpu.analysis import race as _race  # noqa: E402
+
+
+def pytest_configure(config):
+    # OPENR_TSAN=1 arms the happens-before race detector HERE — before
+    # test modules import and construct modules/locks/queues, so every
+    # Lock/Condition created for the suite is a proxy and every tracked
+    # class carries its access hooks (no-op otherwise; docs/OPERATIONS.md)
+    _race.maybe_enable()
+
+
+@pytest.fixture(autouse=True)
+def tsan_guard():
+    """Zero-unsuppressed-findings gate for armed (OPENR_TSAN=1) runs.
+
+    Drains stale findings before the test, and fails the test that
+    actually produced a race — with both stacks — after it.  Unarmed runs
+    pay one `is None` check."""
+    det = _race.TSAN
+    if det is None:
+        yield
+        return
+    det.drain()
+    yield
+    findings = det.drain()
+    if findings:
+        pytest.fail(_race.format_findings(findings), pytrace=False)
+
 
 @pytest.fixture
 def cpu_devices():

@@ -1,0 +1,311 @@
+"""OPENR_TSAN happens-before race detector: engine, seams and static
+companion rules (openr_tpu/analysis/race.py, analysis/threads.py).
+
+Dynamic tests run the seeded scenarios in
+tests/analysis_fixtures/race_dynamic.py against the real detector —
+armed here if the suite is not already running under OPENR_TSAN=1, in
+which case the session detector is reused (and never disarmed
+mid-suite).  Static tests assert exact (rule, line) pairs on the seeded
+lock-order / guarded-by / shutdown-order fixtures, mirroring
+tests/test_analysis.py.
+"""
+
+import importlib.util
+import threading
+from pathlib import Path
+
+import pytest
+
+from openr_tpu.analysis import race
+from openr_tpu.analysis.core import AnalysisConfig, run_analysis
+from openr_tpu.runtime.eventbase import OpenrEventBase
+from openr_tpu.runtime.queue import RWQueue
+
+pytestmark = pytest.mark.analysis
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+FIXTURES = REPO_ROOT / "tests" / "analysis_fixtures"
+
+_DYN_PATH = FIXTURES / "race_dynamic.py"
+_spec = importlib.util.spec_from_file_location("race_dynamic", _DYN_PATH)
+race_dynamic = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(race_dynamic)
+
+_DYN_LINES = _DYN_PATH.read_text().splitlines()
+
+
+def _marked_line(marker: str) -> int:
+    """1-based line of the unique trailing `# <marker>` comment."""
+    hits = [
+        i
+        for i, line in enumerate(_DYN_LINES, 1)
+        if line.rstrip().endswith("# " + marker)
+    ]
+    assert len(hits) == 1, f"marker {marker} not unique: {hits}"
+    return hits[0]
+
+
+def _site(stack: tuple) -> tuple:
+    return stack[0][:2] if stack else ("<unknown>", 0)
+
+
+def _state_findings(det: race.RaceDetector) -> list[race.RaceFinding]:
+    return [f for f in det.drain() if f.cls_name == "State"]
+
+
+@pytest.fixture
+def det():
+    """The active detector: the session one when the suite runs armed
+    (OPENR_TSAN=1), otherwise armed fresh for this test and disarmed
+    after.  Either way the fixture State class is tracked and findings
+    are drained on both sides."""
+    was_armed = race.TSAN is not None
+    d = race.TSAN if was_armed else race.enable(tracked_paths=[])
+    race.track_class(race_dynamic.State)
+    d.drain()
+    try:
+        yield d
+    finally:
+        d.drain()
+        if not was_armed:
+            race.disable()
+
+
+# ---------------------------------------------------------------------------
+# Dynamic: seeded races are detected with exact sites
+# ---------------------------------------------------------------------------
+
+
+def test_bare_write_race_detected_with_exact_sites(det):
+    race_dynamic.bare_write_race()
+    findings = _state_findings(det)
+    assert len(findings) == 1
+    (f,) = findings
+    assert f.kind == "write-write"
+    assert f.attr == "value"
+    assert sorted((_site(f.prior_stack), _site(f.stack))) == sorted(
+        (
+            (str(_DYN_PATH), _marked_line("RACE-A")),
+            (str(_DYN_PATH), _marked_line("RACE-B")),
+        )
+    )
+    assert {f.prior_thread, f.thread} == {"race-a", "race-b"}
+
+
+def test_bare_read_race_detected(det):
+    race_dynamic.bare_read_race()
+    findings = _state_findings(det)
+    assert len(findings) == 1
+    (f,) = findings
+    assert f.kind in ("write-read", "read-write")
+    assert f.attr == "value"
+    assert sorted((_site(f.prior_stack), _site(f.stack))) == sorted(
+        (
+            (str(_DYN_PATH), _marked_line("RACE-READ")),
+            (str(_DYN_PATH), _marked_line("RACE-WRITE")),
+        )
+    )
+
+
+def test_same_site_pair_dedups_across_objects(det):
+    race_dynamic.dedup_double_race()
+    findings = _state_findings(det)
+    assert len(findings) == 1
+    (f,) = findings
+    assert _site(f.prior_stack) == _site(f.stack) == (
+        str(_DYN_PATH),
+        _marked_line("RACE-DEDUP"),
+    )
+
+
+def test_missing_token_races(det):
+    race_dynamic.token_missing_race()
+    findings = _state_findings(det)
+    assert len(findings) == 1
+    (f,) = findings
+    assert f.kind == "write-write"
+    assert sorted((_site(f.prior_stack), _site(f.stack))) == sorted(
+        (
+            (str(_DYN_PATH), _marked_line("RACE-TOKEN-A")),
+            (str(_DYN_PATH), _marked_line("RACE-TOKEN-B")),
+        )
+    )
+
+
+# ---------------------------------------------------------------------------
+# Dynamic: happens-before edges silence the same shapes
+# ---------------------------------------------------------------------------
+
+
+def test_queue_handoff_is_clean(det):
+    race_dynamic.queue_handoff_clean()
+    assert _state_findings(det) == []
+
+
+def test_transitive_hb_through_two_queue_hops(det):
+    race_dynamic.two_hop_relay_clean()
+    assert _state_findings(det) == []
+
+
+def test_lock_release_acquire_edges(det):
+    state = race_dynamic.lock_protected_clean()
+    assert _state_findings(det) == []
+    assert state.value == 100  # the lock actually locked
+
+
+def test_publish_acquire_token_orders_writes(det):
+    race_dynamic.token_ordered_clean(det)
+    assert _state_findings(det) == []
+
+
+# ---------------------------------------------------------------------------
+# Engine units
+# ---------------------------------------------------------------------------
+
+
+def test_leq_componentwise():
+    assert race._leq({}, {})
+    assert race._leq({}, {1: 1})
+    assert not race._leq({1: 1}, {})
+    assert race._leq({1: 1}, {1: 2, 2: 5})
+    assert not race._leq({1: 2, 2: 1}, {1: 2})
+
+
+def _acc(tid, site_line, name="t"):
+    return race._Access(
+        tid, {tid: 1}, name, (("f.py", site_line, "fn"),)
+    )
+
+
+def test_report_dedup_is_order_insensitive():
+    det = race.RaceDetector()
+    a, b = _acc(1, 10), _acc(2, 20)
+    det._report("write-write", ("State", "object"), "value", a, b)
+    det._report("write-write", ("State", "object"), "value", b, a)
+    assert len(det.findings) == 1
+    # the same unordered read/write pair spelled both ways is one finding
+    det._report("read-write", ("State", "object"), "other", a, b)
+    det._report("write-read", ("State", "object"), "other", b, a)
+    assert len(det.findings) == 2
+
+
+def test_suppression_requires_rationale():
+    det = race.RaceDetector()
+    with pytest.raises(ValueError):
+        det.suppress("State", "value", "  ")
+    det.suppress("State", "value", "benign: monotonic latch")
+    det._report("write-write", ("State", "object"), "value", _acc(1, 1), _acc(2, 2))
+    assert det.findings == []
+    assert [(f.cls_name, f.attr, why) for f, why in det.suppressed] == [
+        ("State", "value", "benign: monotonic latch")
+    ]
+
+
+def test_suppressions_match_through_the_mro():
+    det = race.RaceDetector()
+    det.suppress("Base", "value", "benign on the base class")
+    det._report(
+        "write-write", ("Derived", "Base", "object"), "value", _acc(1, 1), _acc(2, 2)
+    )
+    assert det.findings == []
+    assert len(det.suppressed) == 1
+
+
+def test_default_suppressions_all_carry_rationale():
+    assert race.DEFAULT_RUNTIME_SUPPRESSIONS
+    for (cls, attr), why in race.DEFAULT_RUNTIME_SUPPRESSIONS.items():
+        assert why.strip(), f"({cls}, {attr}) has no rationale"
+
+
+def test_format_names_both_threads_and_stacks():
+    det = race.RaceDetector()
+    det._report(
+        "write-write",
+        ("State", "object"),
+        "value",
+        _acc(1, 10, "thread-a"),
+        _acc(2, 20, "thread-b"),
+    )
+    text = race.format_findings(det.drain())
+    assert "1 unsuppressed race finding" in text
+    assert "write-write race on State.value" in text
+    assert "'thread-a'" in text and "'thread-b'" in text
+    assert "f.py:10" in text and "f.py:20" in text
+
+
+# ---------------------------------------------------------------------------
+# Arming is zero-cost when off, reversible when on
+# ---------------------------------------------------------------------------
+
+_ARMED_SESSION = race.TSAN is not None
+
+
+@pytest.mark.skipif(_ARMED_SESSION, reason="suite is running under OPENR_TSAN=1")
+def test_unarmed_runtime_is_untouched():
+    assert race.TSAN is None
+    assert threading.Lock is race._REAL_LOCK
+    assert threading.RLock is race._REAL_RLOCK
+    assert "__setattr__" not in OpenrEventBase.__dict__
+    q = RWQueue()
+    assert q.push(1)
+    assert q._tsan_tokens is None  # push never allocated the token deque
+    assert q.get(timeout=1) == 1
+
+
+@pytest.mark.skipif(_ARMED_SESSION, reason="suite is running under OPENR_TSAN=1")
+def test_enable_disable_round_trips():
+    race.enable(tracked_paths=[])
+    try:
+        assert race.TSAN is not None
+        assert threading.Lock is race.TsanLock
+        assert threading.RLock is race.TsanRLock
+    finally:
+        race.disable()
+    assert race.TSAN is None
+    assert threading.Lock is race._REAL_LOCK
+    assert threading.RLock is race._REAL_RLOCK
+
+
+# ---------------------------------------------------------------------------
+# Static companion rules: seeded fixtures, exact (rule, line) pairs
+# ---------------------------------------------------------------------------
+
+
+def _fixture_findings(*names):
+    config = AnalysisConfig(
+        jit_paths=["tests/analysis_fixtures"],
+        counter_extra_prefixes=["kvstore", "fib", "queue"],
+    )
+    targets = [FIXTURES / n for n in names]
+    return run_analysis(targets, config, REPO_ROOT)
+
+
+def _pairs(reporter):
+    return sorted((f.rule, f.line) for f in reporter.findings)
+
+
+def test_lock_order_and_guarded_by_fixture():
+    rep = _fixture_findings("race_lockorder.py")
+    assert _pairs(rep) == [
+        ("guarded-by", 57),
+        ("lock-order", 19),
+        ("lock-order", 24),
+    ]
+    # each inversion cites the site taking the reverse order
+    by_line = {f.line: f.message for f in rep.findings if f.rule == "lock-order"}
+    assert "race_lockorder.py:24" in by_line[19]
+    assert "race_lockorder.py:19" in by_line[24]
+    # the quiesced reset carries a suppression marker
+    assert [(s.rule, s.line) for s in rep.suppressed] == [("guarded-by", 61)]
+
+
+def test_shutdown_order_fixture():
+    rep = _fixture_findings("shutdown_order.py")
+    assert _pairs(rep) == [
+        ("thread-shutdown-order", 21),
+        ("thread-shutdown-order", 23),
+    ]
+    messages = sorted(f.message for f in rep.findings)
+    assert "never closed" in messages[1]
+    assert "runs before `self.updates` closes" in messages[0]
+    assert rep.suppressed == []
