@@ -75,6 +75,30 @@ def _budget_left() -> float:
     return BUDGET_S - (time.monotonic() - _START)
 
 
+def _shed_marker(section: str) -> dict:
+    """Pre-check shed row: emitted INSTEAD OF starting a compile-heavy
+    section when the remaining wall budget cannot cover it — the row
+    dies cleanly in the artifact rather than the whole run dying at
+    rc=124 mid-compile (BENCH_r05)."""
+    return {
+        "error": (
+            f"skipped: wall budget exhausted before {section} "
+            f"(shed marker, OPENR_BENCH_BUDGET_S)"
+        )
+    }
+
+
+def _child_env(**extra: str) -> dict:
+    """Environment for a child process: the global budget var is
+    rewritten to the REMAINING budget so the child's own shed
+    pre-checks measure from the right clock (a child restarts
+    time.monotonic() accounting from its own import)."""
+    env = {**os.environ, **extra}
+    if BUDGET_S > 0:
+        env["OPENR_BENCH_BUDGET_S"] = str(max(_budget_left(), 1.0))
+    return env
+
+
 def _attach_bw(row: dict, bytes_moved: Optional[float], wall_ms) -> dict:
     """Record the utilization lens on a device row: estimated HBM bytes
     moved by one timed call and the achieved fraction of peak BW
@@ -2643,6 +2667,15 @@ def _device_child(rows_file: str, skip: set[str]) -> None:
         for name, fn in DEVICE_ROWS.items():
             if name in skip:
                 continue
+            if _budget_left() < 90:
+                # pre-check BEFORE starting a compile-heavy row: a row
+                # begun with seconds left gets killed mid-compile by
+                # the parent watchdog (or the driver's rc=124 timeout)
+                record = {"row": name, **_shed_marker(name)}
+                out.write(json.dumps(record) + "\n")
+                out.flush()
+                os.fsync(out.fileno())
+                continue
             # stderr: the bench contract is ONE JSON line on stdout
             print(f"[device-child] row {name} ...", file=sys.stderr, flush=True)
             t0 = time.perf_counter()
@@ -2766,6 +2799,7 @@ def _run_device_rows(details: dict) -> None:
                 "--skip",
                 ",".join(succeeded),
             ],
+            env=_child_env(),
         )
         last_size = -1
         last_progress = time.monotonic()
@@ -2907,9 +2941,7 @@ def main() -> None:
     ):
         host_names.append(name)
         if _budget_left() < 60:
-            details["rows"][name] = {
-                "error": "skipped: wall budget exhausted"
-            }
+            details["rows"][name] = _shed_marker(name)
             _flush_details(details)
             continue
         try:
@@ -2920,9 +2952,9 @@ def main() -> None:
     # virtual-mesh scaling evidence (r3 next #8): child process so the
     # 8-device CPU mesh env never touches this process's TPU platform
     if _budget_left() < 60:
-        details["rows"]["virtual_mesh_scaling"] = {
-            "error": "skipped: wall budget exhausted"
-        }
+        details["rows"]["virtual_mesh_scaling"] = _shed_marker(
+            "virtual_mesh_scaling"
+        )
     else:
         try:
             proc = subprocess.run(
@@ -2930,11 +2962,10 @@ def main() -> None:
                 capture_output=True,
                 text=True,
                 timeout=min(900.0, max(_budget_left(), 60.0)),
-                env={
-                    **os.environ,
-                    "JAX_PLATFORMS": "cpu",
-                    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
-                },
+                env=_child_env(
+                    JAX_PLATFORMS="cpu",
+                    XLA_FLAGS="--xla_force_host_platform_device_count=8",
+                ),
             )
             details["rows"]["virtual_mesh_scaling"] = json.loads(
                 proc.stdout.strip().splitlines()[-1]
@@ -2950,9 +2981,7 @@ def main() -> None:
     from benchmarks import host_subsystems
 
     if _budget_left() < 60:
-        details["rows"]["host_subsystems"] = {
-            "error": "skipped: wall budget exhausted"
-        }
+        details["rows"]["host_subsystems"] = _shed_marker("host_subsystems")
     else:
         try:
             details["rows"]["host_subsystems"] = host_subsystems.run_all()

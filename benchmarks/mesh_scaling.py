@@ -46,6 +46,19 @@ def _budget_left() -> float:
     return _BUDGET_S - (time.monotonic() - _START)
 
 
+def _shed_marker(section: str) -> dict:
+    """Pre-check shed row: emitted INSTEAD OF starting a compile-heavy
+    section when the remaining wall budget cannot cover it — the row
+    dies cleanly in the artifact rather than the harness dying at
+    rc=124 mid-compile (BENCH_r05)."""
+    return {
+        "error": (
+            f"skipped: wall budget exhausted before {section} "
+            f"(shed marker, OPENR_BENCH_BUDGET_S)"
+        )
+    }
+
+
 def _collect(step, args, mesh_desc: str, execute: bool = True):
     import jax
 
@@ -98,9 +111,17 @@ def _collect_phase(lowered) -> dict:
     if isinstance(cost, list):
         cost = cost[0]
     hlo = compiled.as_text()
+    from openr_tpu.parallel import hlo_async
+
+    gather_bytes = sum(
+        hlo_async.shape_bytes(line.split("all-gather(")[0])
+        for line in hlo.splitlines()
+        if " all-gather(" in line
+    )
     return {
         "flops_per_device": float(cost.get("flops", 0.0)),
         "bytes_per_device": float(cost.get("bytes accessed", 0.0)),
+        "gather_bytes": gather_bytes,
         "collectives": {
             op: hlo.count(op)
             for op in (
@@ -152,6 +173,12 @@ def _blocked_rows(n_nodes: int, tile: int) -> dict:
             blk.blocked_outer.lower(dist, row_p, col_p, ov, k, mesh=mesh)
         ),
     }
+    # per-round collective bytes of the bulk-synchronous loop: the
+    # gathers live in the diag + panels modules (outer is
+    # collective-free) — summed from the compiled output shapes
+    gather_bytes = 0
+    for ph in ("diag", "panels"):
+        gather_bytes += phases[ph].get("gather_bytes", 0)
     # ideal per-device cost of one rank-1 min-plus step of the dominant
     # outer phase (the unit the while-body accounting reports, see
     # _collect_phase): every device touches its Np^2/D state slab twice
@@ -169,6 +196,7 @@ def _blocked_rows(n_nodes: int, tile: int) -> dict:
         "rounds": t,
         "mesh": "batch=1,row=2,col=4",
         "phases": phases,
+        "round_gather_bytes": gather_bytes,
         "outer_ideal_bytes_per_device": ideal_bytes,
         "outer_ideal_flops_per_device": ideal_flops,
         "outer_bytes_ratio": (
@@ -189,6 +217,109 @@ def _blocked_rows(n_nodes: int, tile: int) -> dict:
             "the product T rounds.  Collectives per phase: the diag "
             "tile replicates, the panels all-gather over row/col, the "
             "outer update is collective-free."
+        ),
+    }
+
+
+def _pipelined_row(n_nodes: int, tile: int, bulk_row: dict) -> dict:
+    """Compile-only evidence for the software-pipelined blocked round
+    at planet scale: AOT-lower `blocked_round_pipelined` on the 1x2x4
+    virtual mesh, then let `parallel.hlo_async` materialize the async
+    all-gather-start/done spans from the scheduled module and verify —
+    from real def-use chains — that the panel gathers bracket the
+    rank-5 outer-update while.  The headline asserts are hard: a
+    regression that re-serializes the collectives fails the row."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from openr_tpu.parallel import blocked as blk
+    from openr_tpu.parallel import hlo_async
+
+    mesh = blk.make_blocked_mesh(jax.devices("cpu")[:8])  # 1 x 2 x 4
+    b = tile
+    t = -(-n_nodes // b)
+    n_pad = t * b
+    aval = jax.ShapeDtypeStruct
+    args = (
+        aval(
+            (1, t, b, t, b),
+            jnp.uint32,
+            sharding=NamedSharding(mesh, P("batch", None, "row", None, "col")),
+        ),
+        aval(
+            (1, b, t, b),
+            jnp.uint32,
+            sharding=NamedSharding(mesh, P("batch", None, None, "col")),
+        ),
+        aval(
+            (1, t, b, b),
+            jnp.uint32,
+            sharding=NamedSharding(mesh, P("batch", None, "row", None)),
+        ),
+        aval((n_pad,), jnp.bool_, sharding=NamedSharding(mesh, P())),
+        aval((), jnp.int32, sharding=NamedSharding(mesh, P())),
+    )
+    txt = (
+        blk.blocked_round_pipelined.lower(*args, mesh=mesh)
+        .compile()
+        .as_text()
+    )
+    rep = hlo_async.async_report(txt)
+    # headline: the start/done pairs BRACKET compute, per the def-use
+    # graph of the compiled module — not an empty or illegal window
+    assert rep["outer_update"] is not None, "no rank-5 outer-update while"
+    assert rep["panel_overlap_ok"], rep["spans"]
+    assert all(s["legal"] for s in rep["spans"]), rep["spans"]
+    assert all(
+        s["compute_in_span"]
+        for s in rep["spans"]
+        if s["spans_outer_update"]
+    ), rep["spans"]
+    bulk_bytes = (
+        bulk_row.get("round_gather_bytes") if isinstance(bulk_row, dict)
+        else None
+    )
+    return {
+        "n_nodes": n_nodes,
+        "n_pad": n_pad,
+        "tile": b,
+        "rounds": t,
+        "mesh": "batch=1,row=2,col=4",
+        "collectives": rep["n_collectives"],
+        "outer_update_while": rep["outer_update"],
+        "spans_bracketing_outer": len(
+            [s for s in rep["spans"] if s["spans_outer_update"]]
+        ),
+        "overlap_frac_est": rep["overlap_frac_est"],
+        "round_gather_bytes": rep["collective_bytes"],
+        "bulk_round_gather_bytes": bulk_bytes,
+        "gather_bytes_vs_bulk": (
+            round(rep["collective_bytes"] / bulk_bytes, 4)
+            if bulk_bytes
+            else None
+        ),
+        "spans": [
+            {
+                "name": s["name"],
+                "bytes_out": s["bytes_out"],
+                "compute_ops_in_span": len(s["compute_in_span"]),
+                "spans_outer_update": s["spans_outer_update"],
+                "legal": s["legal"],
+            }
+            for s in rep["spans"]
+        ],
+        "note": (
+            "compile-only: the fused pipelined round is AOT-lowered at "
+            "N=1M and the async all-gather-start/done spans are "
+            "materialized by parallel.hlo_async from the scheduled "
+            "module's def-use chains (the CPU backend overlaps "
+            "independent thunks as a dataflow DAG instead of emitting "
+            "the start/done pair; legality is the same rule XLA's "
+            "async scheduler applies on TPU).  The two panel gathers' "
+            "spans bracket the rank-5 outer-update while; the diagonal "
+            "replication is dep-chained through the row-panel gather, "
+            "so a linear schedule provably cannot also nest it."
         ),
     }
 
@@ -227,34 +358,49 @@ def run(n_side: int = 32, n_sources: int = 1024, n_variants: int = 256) -> dict:
         rows["allsrc"].append(_collect(step, base_args, f"batch={b}"))
 
     # masked what-if fleet over the variant axis
-    rng = np.random.default_rng(3)
-    mask_t = np.ones((topo.edge_capacity, n_variants), dtype=bool)
-    fail = rng.integers(0, topo.n_edges, size=n_variants)
-    mask_t[fail, np.arange(n_variants)] = False
-    wa_args = (
-        jnp.zeros(n_variants, dtype=jnp.int32),
-        topo.ell,
-        jnp.asarray(topo.edge_src),
-        jnp.asarray(topo.edge_dst),
-        jnp.asarray(topo.edge_metric),
-        jnp.asarray(topo.edge_up),
-        jnp.asarray(topo.node_overloaded),
-        jnp.asarray(mask_t),
-    )
-    for b in (1, 8):
-        mesh = pmesh.make_mesh(jax.devices("cpu")[:b], batch_axis=b)
-        step = pmesh.whatif_step_sharded(mesh)
-        rows["whatif"].append(_collect(step, wa_args, f"batch={b}"))
+    if _budget_left() < 60:
+        rows["whatif"] = _shed_marker("whatif")
+    else:
+        rng = np.random.default_rng(3)
+        mask_t = np.ones((topo.edge_capacity, n_variants), dtype=bool)
+        fail = rng.integers(0, topo.n_edges, size=n_variants)
+        mask_t[fail, np.arange(n_variants)] = False
+        wa_args = (
+            jnp.zeros(n_variants, dtype=jnp.int32),
+            topo.ell,
+            jnp.asarray(topo.edge_src),
+            jnp.asarray(topo.edge_dst),
+            jnp.asarray(topo.edge_metric),
+            jnp.asarray(topo.edge_up),
+            jnp.asarray(topo.node_overloaded),
+            jnp.asarray(mask_t),
+        )
+        for b in (1, 8):
+            mesh = pmesh.make_mesh(jax.devices("cpu")[:b], batch_axis=b)
+            step = pmesh.whatif_step_sharded(mesh)
+            rows["whatif"].append(_collect(step, wa_args, f"batch={b}"))
 
     # node-axis split: collectives must appear
-    mesh_node = pmesh.make_mesh(jax.devices("cpu")[:8], batch_axis=1)
-    step = pmesh.spf_step_sharded(mesh_node)
-    rows["node_axis"] = _collect(step, base_args, "batch=1,node=8")
+    if _budget_left() < 60:
+        rows["node_axis"] = _shed_marker("node_axis")
+    else:
+        mesh_node = pmesh.make_mesh(jax.devices("cpu")[:8], batch_axis=1)
+        step = pmesh.spf_step_sharded(mesh_node)
+        rows["node_axis"] = _collect(step, base_args, "batch=1,node=8")
 
     # round-5: the reduced all-sources FLEET product with the dest axis
     # sharded over the batch mesh (parallel/mesh.fleet_product_sharded);
     # relax + bitmap must stay collective-free per shard, verdict only
     from openr_tpu.ops import allsources as asrc
+
+    if _budget_left() < 90:
+        # the fleet-product rows compile the full product program twice
+        # (b=1 and b=8) — pre-check instead of dying mid-compile
+        rows["fleet_product"] = _shed_marker("fleet_product")
+        rows["fleet_product_wan100k"] = _shed_marker("fleet_product_wan100k")
+        rows["blocked_1m"] = _shed_marker("blocked_1m")
+        rows["blocked_pipelined_1m"] = _shed_marker("blocked_pipelined_1m")
+        return _summary(topo, n_sources, n_variants, rows)
 
     wtopo = synthetic.wan(4096, chords=2, seed=1)
     wrev = synthetic.reversed_topology(wtopo)
@@ -308,50 +454,57 @@ def run(n_side: int = 32, n_sources: int = 1024, n_variants: int = 256) -> dict:
     # compile-only.  The sweep hint stays at the runner default: fixed
     # sweeps scale the b=1 and b=8 programs identically, so the flops
     # ratio and the collective count are hint-invariant.
-    try:
-        w100 = synthetic.wan()  # 100k nodes, chords=2
-        w100runner = synthetic.reversed_topology(w100).runner
-        rng100 = np.random.default_rng(7)
-        dests100 = np.sort(
-            rng100.choice(w100.n_nodes, size=1024, replace=False).astype(
-                np.int32
-            )
+    if _budget_left() < 120:
+        # two more full-product compiles at 100k nodes — shed, do
+        # not die mid-row (BENCH_r05 hit rc=124 exactly here)
+        rows["fleet_product_wan100k"] = _shed_marker(
+            "fleet_product_wan100k"
         )
-        out100 = asrc.build_out_ell(
-            w100.edge_src, w100.edge_dst, w100.n_edges, w100.n_nodes
-        )
-        es_1, ed_1, em_1, eu_1, ov_1 = w100runner.arrays
-        fleet100_args = (
-            jnp.asarray(dests100),
-            w100runner.bg,
-            jnp.asarray(es_1),
-            jnp.asarray(ed_1),
-            jnp.asarray(em_1),
-            jnp.asarray(eu_1),
-            jnp.asarray(ov_1),
-            out100,
-            jnp.asarray(w100.edge_metric),
-            jnp.asarray(w100.edge_up),
-        )
-        rows["fleet_product_wan100k"] = []
-        for b in (1, 8):
-            mesh = pmesh.make_mesh(jax.devices("cpu")[:b], batch_axis=b)
-            step = pmesh.fleet_product_sharded(
-                mesh,
-                n_sweeps=w100runner.hint,
-                n_words=out100.n_words,
-                depth=w100runner.depth,
-                resid_rounds=w100runner.resid_rounds,
-                small_dist=w100runner.small_dist,
-                chord_mode=w100runner.chord_mode,
+    else:
+        try:
+            w100 = synthetic.wan()  # 100k nodes, chords=2
+            w100runner = synthetic.reversed_topology(w100).runner
+            rng100 = np.random.default_rng(7)
+            dests100 = np.sort(
+                rng100.choice(w100.n_nodes, size=1024, replace=False).astype(
+                    np.int32
+                )
             )
-            rows["fleet_product_wan100k"].append(
-                _collect(step, fleet100_args, f"batch={b}", execute=False)
+            out100 = asrc.build_out_ell(
+                w100.edge_src, w100.edge_dst, w100.n_edges, w100.n_nodes
             )
-    except Exception as exc:  # keep the small-topology rows publishable
-        rows["fleet_product_wan100k"] = {
-            "error": f"{type(exc).__name__}: {exc}"
-        }
+            es_1, ed_1, em_1, eu_1, ov_1 = w100runner.arrays
+            fleet100_args = (
+                jnp.asarray(dests100),
+                w100runner.bg,
+                jnp.asarray(es_1),
+                jnp.asarray(ed_1),
+                jnp.asarray(em_1),
+                jnp.asarray(eu_1),
+                jnp.asarray(ov_1),
+                out100,
+                jnp.asarray(w100.edge_metric),
+                jnp.asarray(w100.edge_up),
+            )
+            rows["fleet_product_wan100k"] = []
+            for b in (1, 8):
+                mesh = pmesh.make_mesh(jax.devices("cpu")[:b], batch_axis=b)
+                step = pmesh.fleet_product_sharded(
+                    mesh,
+                    n_sweeps=w100runner.hint,
+                    n_words=out100.n_words,
+                    depth=w100runner.depth,
+                    resid_rounds=w100runner.resid_rounds,
+                    small_dist=w100runner.small_dist,
+                    chord_mode=w100runner.chord_mode,
+                )
+                rows["fleet_product_wan100k"].append(
+                    _collect(step, fleet100_args, f"batch={b}", execute=False)
+                )
+        except Exception as exc:  # keep the small-topology rows publishable
+            rows["fleet_product_wan100k"] = {
+                "error": f"{type(exc).__name__}: {exc}"
+            }
 
     # node-axis sharding: the blocked min-plus APSP rung
     # (parallel.blocked) at N >= 1M over the ("batch", "row", "col")
@@ -361,17 +514,44 @@ def run(n_side: int = 32, n_sources: int = 1024, n_variants: int = 256) -> dict:
     # bytes/FLOPs of the compiled body are compared against the ideal
     # N^2/devices split with collectives attributed per phase.
     if _budget_left() < 60:
-        rows["blocked_1m"] = {"error": "skipped: wall budget exhausted"}
+        rows["blocked_1m"] = _shed_marker("blocked_1m")
     else:
         try:
             rows["blocked_1m"] = _blocked_rows(n_nodes=1 << 20, tile=4096)
         except Exception as exc:
             rows["blocked_1m"] = {"error": f"{type(exc).__name__}: {exc}"}
 
+    # pipelined blocked closure at the same N (compile-only): lower
+    # the fused blocked_round_pipelined root, materialize async
+    # all-gather-start/done spans from the scheduled HLO, and
+    # headline-assert the pairs bracket the outer-update compute
+    # (hard asserts live inside _pipelined_row).
+    if _budget_left() < 90:
+        rows["blocked_pipelined_1m"] = _shed_marker("blocked_pipelined_1m")
+    else:
+        try:
+            rows["blocked_pipelined_1m"] = _pipelined_row(
+                n_nodes=1 << 20, tile=4096, bulk_row=rows["blocked_1m"]
+            )
+        except Exception as exc:
+            rows["blocked_pipelined_1m"] = {
+                "error": f"{type(exc).__name__}: {exc}"
+            }
+
+    return _summary(topo, n_sources, n_variants, rows)
+
+
+def _summary(topo, n_sources: int, n_variants: int, rows: dict) -> dict:
+    """Assemble the headline summary.  Any row may be a shed-marker or
+    error dict (wall budget exhausted mid-run) — every cross-row ratio
+    degrades to None instead of KeyErroring, so a partial run still
+    emits valid JSON."""
     f1 = rows["allsrc"][0]["flops_per_device"]
     f8 = rows["allsrc"][3]["flops_per_device"]
     w1 = rows["allsrc"][0]["wall_ms_min"]
     w8 = rows["allsrc"][3]["wall_ms_min"]
+    fleet = rows["fleet_product"]
+    pipe = rows["blocked_pipelined_1m"]
     return {
         "topology": topo.name,
         "n_sources": n_sources,
@@ -383,19 +563,21 @@ def run(n_side: int = 32, n_sources: int = 1024, n_variants: int = 256) -> dict:
             round(w8 / w1, 3) if w1 else None
         ),
         "batch_layout_collectives": rows["allsrc"][3]["collective_ops"],
-        "node_layout_collectives": rows["node_axis"]["collective_ops"],
+        "node_layout_collectives": rows["node_axis"].get(
+            "collective_ops"
+        ),
         "fleet_flops_ratio_8dev": (
             round(
-                rows["fleet_product"][1]["flops_per_device"]
-                / rows["fleet_product"][0]["flops_per_device"],
+                fleet[1]["flops_per_device"]
+                / fleet[0]["flops_per_device"],
                 4,
             )
-            if rows["fleet_product"][0]["flops_per_device"]
+            if isinstance(fleet, list) and fleet[0]["flops_per_device"]
             else None
         ),
-        "fleet_8dev_collectives": rows["fleet_product"][1][
-            "collective_ops"
-        ],
+        "fleet_8dev_collectives": (
+            fleet[1]["collective_ops"] if isinstance(fleet, list) else None
+        ),
         "fleet_wan100k_flops_ratio_8dev": (
             round(
                 rows["fleet_product_wan100k"][1]["flops_per_device"]
@@ -416,6 +598,13 @@ def run(n_side: int = 32, n_sources: int = 1024, n_variants: int = 256) -> dict:
         ),
         "blocked_1m_flops_ratio": rows["blocked_1m"].get(
             "outer_flops_ratio"
+        ),
+        "blocked_pipelined_overlap_frac": pipe.get("overlap_frac_est"),
+        "blocked_pipelined_spans_outer": pipe.get(
+            "spans_bracketing_outer"
+        ),
+        "blocked_pipelined_gather_vs_bulk": pipe.get(
+            "gather_bytes_vs_bulk"
         ),
         "note": (
             "virtual 8-device CPU mesh on ONE physical core: wall-clock "

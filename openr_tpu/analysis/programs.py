@@ -410,7 +410,13 @@ def _drive_blocked(state: dict) -> None:
     audit run does not leak environment into other drivers; the asserts
     keep the driver honest — a silent fallback to the fused product
     would leave the blocked roots spec-less and fail the audit later
-    with a much less actionable finding."""
+    with a much less actionable finding.
+
+    Both pipeline settings run (pinned `pipeline_mode`, same no-leak
+    discipline as the threshold): the default lookahead closure must
+    record the fused `blocked_round_pipelined` root — donation has to
+    survive the double-buffered panel carry — and the pinned-off run
+    keeps the bulk-synchronous `blocked_outer` root audit-visible."""
     from ..decision.fleet import FleetViewCache
     from ..device.engine import DeviceResidencyEngine
 
@@ -422,6 +428,22 @@ def _drive_blocked(state: dict) -> None:
     assert view is not None and view.converged and view.node_sharded
     assert engine.blocked.counters["mesh.blocked.products"] == 1
     assert engine.blocked.counters["mesh.blocked.fallbacks"] == 0
+    # auto-on pipelining at n=64/tile=16 -> 4 rounds, 3 prefetches; a
+    # demotion here would silently audit the wrong loop
+    assert (
+        engine.blocked.counters["mesh.blocked.pipeline_prefetch_issues"] > 0
+    )
+    assert engine.blocked.counters["mesh.blocked.pipeline_fallbacks"] == 0
+
+    engine2 = DeviceResidencyEngine()
+    engine2.blocked.node_shard_threshold = 0
+    engine2.blocked.pipeline_mode = "0"  # pinned off: bulk loop
+    view2 = FleetViewCache().view(ls, ["r000", "r031", "r063"], engine=engine2)
+    assert view2 is not None and view2.converged and view2.node_sharded
+    assert engine2.blocked.counters["mesh.blocked.products"] == 1
+    assert (
+        engine2.blocked.counters["mesh.blocked.pipeline_prefetch_issues"] == 0
+    )
 
 
 def _drive_pallas(state: dict) -> None:

@@ -123,6 +123,8 @@ class Link:
         "nh_v4_2",
         "nh_v6_1",
         "nh_v6_2",
+        "weight1",
+        "weight2",
         "_hold_up_ttl",
         "ordered_names",
         "_hash",
@@ -151,6 +153,10 @@ class Link:
         self.nh_v4_2 = adj2.next_hop_v4
         self.nh_v6_1 = adj1.next_hop_v6
         self.nh_v6_2 = adj2.next_hop_v6
+        # UCMP adjacency weights (SP_UCMP_ADJ_WEIGHT_PROPAGATION);
+        # captured at link construction like the label/next-hop fields
+        self.weight1 = adj1.weight
+        self.weight2 = adj2.weight
         self._hold_up_ttl = 0
         a, b = (self.n1, self.if1), (self.n2, self.if2)
         self.ordered_names = (a, b) if a <= b else (b, a)
@@ -190,6 +196,9 @@ class Link:
 
     def iface_from_node(self, node: str) -> str:
         return self.if1 if self._side(node) == 1 else self.if2
+
+    def weight_from_node(self, node: str) -> int:
+        return self.weight1 if self._side(node) == 1 else self.weight2
 
     def metric_from_node(self, node: str) -> int:
         return (self._metric1 if self._side(node) == 1 else self._metric2).value

@@ -19,7 +19,9 @@ from __future__ import annotations
 
 import ipaddress
 import logging
+import math
 import weakref
+from dataclasses import replace
 from typing import Optional, Protocol
 
 import numpy as np
@@ -672,7 +674,9 @@ class SpfSolver:
         forwarding_type, forwarding_algo = self._forwarding_type_and_algorithm(
             prefix_entries, best.all_node_areas
         )
-        if forwarding_algo == PrefixForwardingAlgorithm.SP_ECMP:
+        if forwarding_algo != PrefixForwardingAlgorithm.KSP2_ED_ECMP:
+            # SP_ECMP and both SP_UCMP_* algorithms share the
+            # shortest-path machinery; UCMP only re-weights the set
             return self._select_best_paths_spf(
                 prefix,
                 best,
@@ -680,6 +684,7 @@ class SpfSolver:
                 has_bgp,
                 forwarding_type,
                 area_link_states,
+                forwarding_algo,
             )
         return self._select_best_paths_ksp2(
             prefix,
@@ -845,6 +850,9 @@ class SpfSolver:
         is_bgp: bool,
         forwarding_type: PrefixForwardingType,
         area_link_states: dict[str, LinkState],
+        forwarding_algo: PrefixForwardingAlgorithm = (
+            PrefixForwardingAlgorithm.SP_ECMP
+        ),
     ) -> Optional[RibUnicastEntry]:
         """Reference: selectBestPathsSpf (Decision.cpp:905-963)."""
         is_v4 = ipaddress.ip_network(prefix).version == 4
@@ -881,9 +889,76 @@ class SpfSolver:
             area_link_states,
             prefix_entries,
         )
+        if forwarding_algo != PrefixForwardingAlgorithm.SP_ECMP:
+            nexthops = self._apply_ucmp_weights(
+                forwarding_algo,
+                filtered_node_areas,
+                nexthops,
+                area_link_states,
+                prefix_entries,
+            )
         return self._add_best_paths(
             prefix, best, prefix_entries, is_bgp, nexthops
         )
+
+    def _apply_ucmp_weights(
+        self,
+        algo: PrefixForwardingAlgorithm,
+        dst_node_areas: set[NodeAndArea],
+        nexthops: set[NextHop],
+        area_link_states: dict[str, LinkState],
+        prefix_entries: PrefixEntries,
+    ) -> set[NextHop]:
+        """UCMP next-hop weights over the already-selected ECMP set
+        (reference: the DecisionTest Ucmp tranche semantics).
+
+        SP_UCMP_PREFIX_WEIGHT_PROPAGATION: every first-hop neighbor
+        accumulates `PrefixEntry.weight` from each min-metric advertiser
+        it reaches on a shortest path; parallel links to one neighbor
+        share the neighbor's weight.  Attribution reuses
+        getNextHopsWithMetric's per-destination keys, which are the
+        documented parity surface between the host SPF and the fleet
+        product (`_fleet_next_hops_with_metric`), so both backends
+        assign identical weights.
+
+        SP_UCMP_ADJ_WEIGHT_PROPAGATION: each next-hop takes its own
+        first-hop adjacency weight (`Adjacency.weight` via the link).
+
+        Weights are normalized by their gcd.  If no positive weight
+        survives (no advertiser set one, or every weighted path lost
+        the metric race), the set is returned unweighted — plain ECMP
+        instead of a black hole."""
+        link_w: dict[tuple[str, str], int] = {}
+        if algo == PrefixForwardingAlgorithm.SP_UCMP_ADJ_WEIGHT_PROPAGATION:
+            for area, link_state in area_link_states.items():
+                for link in link_state.links_from_node(self.my_node_name):
+                    link_w[(area, link.iface_from_node(self.my_node_name))] = (
+                        link.weight_from_node(self.my_node_name)
+                    )
+
+        acc: dict[str, int] = {}
+        if algo == PrefixForwardingAlgorithm.SP_UCMP_PREFIX_WEIGHT_PROPAGATION:
+            _, per_dst = self._get_next_hops_with_metric(
+                dst_node_areas, True, area_link_states
+            )
+            by_dst: dict[str, int] = {}
+            for node, area in dst_node_areas:
+                w = prefix_entries[(node, area)].weight or 0
+                by_dst[node] = max(by_dst.get(node, 0), w)
+            for (nh_name, dst_node), _dist in per_dst.items():
+                acc[nh_name] = acc.get(nh_name, 0) + by_dst.get(dst_node, 0)
+
+        raw: list[tuple[NextHop, int]] = []
+        for nh in nexthops:
+            if algo == PrefixForwardingAlgorithm.SP_UCMP_ADJ_WEIGHT_PROPAGATION:
+                w = link_w.get((nh.area, nh.if_name), 0)
+            else:
+                w = acc.get(nh.neighbor_node_name, 0)
+            raw.append((nh, max(w, 0)))
+        norm = math.gcd(*(w for _nh, w in raw))
+        if norm == 0:
+            return nexthops
+        return {replace(nh, weight=w // norm) for nh, w in raw}
 
     # -- KSP2_ED_ECMP --------------------------------------------------------
 

@@ -373,13 +373,19 @@ def fused_epilogue(ops, bg, d, resid_slot, band_slot, n_words, *, interpret):
 # -- kernel 2: blocked rank-B outer update ------------------------------------
 
 
-def _outer_kernel(d_ref, c_ref, r_ref, o_ref, *, b: int):
+def _outer_kernel(d_ref, c_ref, r_ref, ov_ref, o_ref, *, b: int):
     """One [ti, tj] distance tile: rank-B saturating min-plus update
-    from the resident [ti, B] col / [B, tj] row panel blocks."""
+    from the resident [ti, B] col / [B, tj] row panel blocks.  The
+    drain mask lands HERE, in the kernel prologue — row m of the row
+    panel block lifts to INF when lane m of tile k is overloaded — so
+    the launch consumes the raw panels the moment they land (the
+    pipelined round hands them straight off the prefetch) instead of
+    waiting on a masked staging copy."""
     d = d_ref[0]
     c = c_ref[0]
-    r = r_ref[0]
     infu = jnp.uint32(_INF32)
+    ov = ov_ref[0]  # [B] int32 drain lanes of tile k
+    r = jnp.where(ov[:, None] != 0, infu, r_ref[0])
 
     def body(m, acc):
         cm = lax.dynamic_slice_in_dim(c, m, 1, axis=1)  # [ti, 1]
@@ -400,12 +406,15 @@ def blocked_outer_pallas(
     write-back in XLA, then the rank-B outer update as a tiled kernel
     over the [Np, Np] view of the tile tensor.
 
-    The drain mask folds into the row panel BEFORE the launch
-    (`row[m, :] = INF` where lane m of tile k is overloaded): bit-exact
-    against the per-m `where(ov_m, INF, cand)` of the XLA kernel
-    because `min(c + INF, INF) == INF` and uint32 never wraps for
-    operands <= 2^30.  Integer min is exact and order-free, so the
-    m-loop accumulation matches XLA's bit for bit.
+    The drain mask folds into the kernel PROLOGUE (`_outer_kernel`
+    lifts row m of the row-panel block to INF where lane m of tile k
+    is overloaded): bit-exact against the per-m `where(ov_m, INF,
+    cand)` of the XLA kernel because `min(c + INF, INF) == INF` and
+    uint32 never wraps for operands <= 2^30.  Integer min is exact and
+    order-free, so the m-loop accumulation matches XLA's bit for bit.
+    Keeping the mask out of the host-side prep means no staging copy
+    of the panels sits between the (possibly prefetched) panel landing
+    and the launch.
 
     Donation note: `dist` is donated (matching `blocked_outer`).  Every
     demotion trigger — conformance gates below, Mosaic lowering errors,
@@ -417,9 +426,11 @@ def blocked_outer_pallas(
     dist = lax.dynamic_update_index_in_dim(dist, row_p, k, axis=1)
     dist = lax.dynamic_update_index_in_dim(dist, col_p, k, axis=3)
     ov = lax.dynamic_slice_in_dim(node_overloaded, k * b, b)  # [B] bool
-    infu = jnp.uint32(_INF32)
-    rm = jnp.where(ov[None, :, None], infu, row_p.reshape(s, b, np_))
+    rm = row_p.reshape(s, b, np_)
     cm = col_p.reshape(s, np_, b)
+    # [8, B] int32 mask table (8 sublanes for Mosaic conformance; the
+    # kernel reads row 0)
+    ovt = jnp.zeros((8, b), jnp.int32).at[0].set(ov.astype(jnp.int32))
     d2 = dist.reshape(s, np_, np_)  # tile dims are contiguous: free view
     ti = 128 if np_ % 128 == 0 else b
     if not interpret and (ti % 128 or b % 128):
@@ -430,7 +441,7 @@ def blocked_outer_pallas(
             f"pallas blocked outer: tiles (ti={ti}, B={b}) are not "
             f"Mosaic-conformant (need multiples of 128) — demote to XLA"
         )
-    if not interpret and 4 * (2 * ti * ti + 2 * ti * b) > _VMEM_BUDGET:
+    if not interpret and 4 * (2 * ti * ti + 2 * ti * b + 8 * b) > _VMEM_BUDGET:
         raise ValueError(
             f"pallas blocked outer: tile ti={ti}, B={b} exceeds the "
             f"{_VMEM_BUDGET} B VMEM budget — demote to XLA"
@@ -442,10 +453,11 @@ def blocked_outer_pallas(
             pl.BlockSpec((1, ti, ti), lambda si, i, j: (si, i, j)),
             pl.BlockSpec((1, ti, b), lambda si, i, j: (si, i, 0)),
             pl.BlockSpec((1, b, ti), lambda si, i, j: (si, 0, j)),
+            pl.BlockSpec((8, b), lambda si, i, j: (0, 0)),
         ],
         out_specs=pl.BlockSpec((1, ti, ti), lambda si, i, j: (si, i, j)),
         out_shape=jax.ShapeDtypeStruct((s, np_, np_), jnp.uint32),
         input_output_aliases={0: 0},
         interpret=interpret,
-    )(d2, cm, rm)
+    )(d2, cm, rm, ovt)
     return out.reshape(s, t, b, t, b)

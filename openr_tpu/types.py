@@ -114,6 +114,11 @@ class PrefixForwardingType(enum.IntEnum):
 class PrefixForwardingAlgorithm(enum.IntEnum):
     SP_ECMP = 0
     KSP2_ED_ECMP = 1
+    # UCMP: shortest-path routing with weighted next-hops (reference:
+    # OpenrConfig.thrift PrefixForwardingAlgorithm; value 2 is unused
+    # there too)
+    SP_UCMP_ADJ_WEIGHT_PROPAGATION = 3
+    SP_UCMP_PREFIX_WEIGHT_PROPAGATION = 4
 
 
 @dataclass(slots=True)
@@ -139,6 +144,9 @@ class PrefixEntry:
     area_stack: tuple[str, ...] = ()
     min_nexthop: Optional[int] = None
     prepend_label: Optional[int] = None
+    # UCMP capacity weight (reference: Types.thrift PrefixEntry.weight):
+    # consumed by SP_UCMP_PREFIX_WEIGHT_PROPAGATION, ignored otherwise
+    weight: Optional[int] = None
     # BGP best-path metric vector (reference: Types.thrift:389 `mv`,
     # compared by MetricVectorUtils::compareMetricVectors, Util.h:479).
     # When absent on BGP-typed entries, selection falls back to the

@@ -7,7 +7,12 @@ bit-exact agreement with the unblocked fused product (reduced_all_sources)
 on ring / grid / fattree / wan-shaped topologies including the 1-device
 degenerate mesh and odd-N padding, the fleet dispatch rung (threshold +
 OPENR_NODE_SHARD engagement, graceful fallback on mesh-shape mismatch,
-chaos partition mid-run), and the make_mesh ValueError contract.
+chaos partition mid-run), the make_mesh ValueError contract, the
+software-pipelined loop (pipelined-vs-bulk bit-exactness on every
+family, chaos fault mid-pipeline demoting to bulk, pipeline_* counter
+semantics), and the compile-only async-span evidence that the
+lookahead panel all-gathers legally bracket the outer-update while
+(parallel.hlo_async on the lowered scheduled module).
 """
 
 from __future__ import annotations
@@ -502,3 +507,296 @@ class TestDispatchRung:
         vf = FleetViewCache().view(self._ls(), dests)
         for node in nodes:
             assert np.array_equal(view._row(node), vf._row(node))
+
+
+def _blocked_product_mode(topo, dest_ids, mesh, tile, pipeline_mode):
+    """_blocked_product with the pipeline override pinned on the engine
+    (the same no-env-leak discipline the program auditor uses)."""
+    eng = blk.BlockedApspEngine(tile=tile, mesh=mesh)
+    eng.pipeline_mode = pipeline_mode
+    dist, bitmap, ok = eng.fleet_product(
+        topo, np.asarray(dest_ids, dtype=np.int32), _out_ell(topo)
+    )
+    assert ok
+    return (
+        np.asarray(jax.device_get(dist)),
+        np.asarray(jax.device_get(bitmap)),
+        eng,
+    )
+
+
+class TestPipelinedParity:
+    """The software-pipelined loop (auto-on default for multi-round
+    closures) against the bulk-synchronous loop: bit-exact on every
+    topology family, correct pipeline_* counter semantics, 1-device
+    degenerate mesh parity, chaos fault mid-pipeline demoting to bulk
+    with `mesh.blocked.pipeline_fallbacks` accounted."""
+
+    @pytest.mark.parametrize(
+        "dbs_fn",
+        [
+            lambda: ring_topology(17),  # odd N: drags the padding tail
+            lambda: grid_topology(4),
+            lambda: fat_tree_topology(2),
+            lambda: _overload(grid_topology(4), "node-1-1"),
+        ],
+        ids=["ring17", "grid4x4", "fattree", "grid-drained"],
+    )
+    def test_pipelined_matches_bulk(self, eight_cpu_devices, dbs_fn):
+        csr = _csr(dbs_fn())
+        n = int(csr.n_nodes)
+        dests = np.asarray(sorted({0, n // 3, n - 1}), dtype=np.int32)
+        mesh = blk.make_blocked_mesh(eight_cpu_devices)
+        # tile 4 forces a genuinely multi-round closure
+        d_bulk, b_bulk, e_bulk = _blocked_product_mode(
+            csr, dests, mesh, 4, "0"
+        )
+        d_pipe, b_pipe, e_pipe = _blocked_product_mode(
+            csr, dests, mesh, 4, "1"
+        )
+        assert np.array_equal(d_pipe, d_bulk)
+        assert np.array_equal(b_pipe, b_bulk)
+        t = e_pipe.counters["mesh.blocked.rounds"]
+        assert t >= 2
+        assert e_pipe.counters["mesh.blocked.pipeline_prefetch_issues"] == t - 1
+        assert (
+            e_pipe.counters["mesh.blocked.pipeline_rounds_overlapped"] == t - 1
+        )
+        assert e_pipe.counters["mesh.blocked.pipeline_overlap_frac_est"] > 0
+        assert e_pipe.counters["mesh.blocked.pipeline_fallbacks"] == 0
+        # the bulk engine never touches the pipeline family
+        for key in blk.BLOCKED_COUNTER_KEYS:
+            if "pipeline" in key:
+                assert e_bulk.counters[key] == 0, key
+
+    def test_wan_and_degenerate_mesh_parity(self, eight_cpu_devices):
+        """wan-shaped family plus the 1-device degenerate mesh: the
+        pipelined prefetch on one device is pure compute reordering —
+        still bit-exact, and the overlap counters must say so."""
+        from benchmarks import synthetic
+
+        topo = synthetic.wan(96, chords=2, seed=3)
+        rng = np.random.default_rng(4)
+        dests = np.sort(
+            rng.choice(topo.n_nodes, size=8, replace=False).astype(np.int32)
+        )
+        mesh8 = blk.make_blocked_mesh(eight_cpu_devices)
+        d_bulk, b_bulk, _ = _blocked_product_mode(topo, dests, mesh8, 8, "0")
+        d_pipe, b_pipe, _ = _blocked_product_mode(topo, dests, mesh8, 8, "1")
+        assert np.array_equal(d_pipe, d_bulk)
+        assert np.array_equal(b_pipe, b_bulk)
+        mesh1 = blk.make_blocked_mesh(eight_cpu_devices[:1])
+        d1, b1, e1 = _blocked_product_mode(topo, dests, mesh1, 8, "1")
+        assert np.array_equal(d1, d_bulk)
+        assert np.array_equal(b1, b_bulk)
+        t = e1.counters["mesh.blocked.rounds"]
+        assert e1.counters["mesh.blocked.pipeline_prefetch_issues"] == t - 1
+        assert e1.counters["mesh.blocked.pipeline_rounds_overlapped"] == 0
+        assert e1.counters["mesh.blocked.pipeline_overlap_frac_est"] == 0
+
+    def test_env_knob_forces_bulk(self, eight_cpu_devices, monkeypatch):
+        """OPENR_BLOCKED_PIPELINE=0 forces the bulk loop; unset or any
+        other value keeps the pipelined default for t >= 2."""
+        eng = blk.BlockedApspEngine(
+            tile=4, mesh=blk.make_blocked_mesh(eight_cpu_devices)
+        )
+        monkeypatch.delenv("OPENR_BLOCKED_PIPELINE", raising=False)
+        assert eng.pipeline_enabled(2)
+        assert not eng.pipeline_enabled(1)  # nothing to prefetch
+        monkeypatch.setenv("OPENR_BLOCKED_PIPELINE", "0")
+        assert not eng.pipeline_enabled(4)
+        monkeypatch.setenv("OPENR_BLOCKED_PIPELINE", "1")
+        assert eng.pipeline_enabled(4)
+        # the pinned override outranks the env (auditor discipline)
+        eng.pipeline_mode = "0"
+        assert not eng.pipeline_enabled(4)
+
+    def test_chaos_fault_mid_pipeline_demotes_to_bulk(self, monkeypatch):
+        """A chaos fault at the per-round gate lands inside the
+        pipelined loop first: the rung must account the demotion
+        (`pipeline_fallbacks`), retry bulk-synchronously, and — with
+        the fault still armed — surface the failure to the fleet rung,
+        which serves the view via the fused product as before."""
+        from types import SimpleNamespace
+
+        from openr_tpu.chaos.chaos import ChaosSpfBackend
+
+        monkeypatch.delenv("OPENR_NODE_SHARD", raising=False)
+        monkeypatch.delenv("OPENR_BLOCKED_MESH", raising=False)
+        monkeypatch.delenv("OPENR_BLOCKED_PIPELINE", raising=False)
+        ls = LinkState()
+        for db in grid_topology(4):
+            ls.update_adjacency_database(db)
+        nodes = sorted(ls.node_names)
+        dests = [nodes[0], nodes[-1]]
+        engine = DeviceResidencyEngine()
+        engine.blocked.node_shard_threshold = 0
+        engine.blocked.tile = 4  # multi-round closure -> pipeline engages
+        chaos = ChaosSpfBackend(
+            SimpleNamespace(engine=engine),
+            seed=7,
+            fail_prob=1.0,
+            fail_ops={"engine:blocked_round"},
+        )
+        view = FleetViewCache().view(ls, dests, engine=engine)
+        assert view.converged and not view.node_sharded
+        assert engine.blocked.counters["mesh.blocked.pipeline_fallbacks"] == 1
+        assert engine.blocked.counters["mesh.blocked.fallbacks"] == 1
+        spf_stream = chaos.log.streams().get("spf", [])
+        assert any("engine:blocked_round:fail" in e for e in spf_stream)
+        chaos.disarm()
+        ls2 = LinkState()
+        for db in grid_topology(4):
+            ls2.update_adjacency_database(db)
+        vf = FleetViewCache().view(ls2, dests)
+        for node in nodes:
+            assert np.array_equal(view._row(node), vf._row(node))
+
+    def test_transient_fault_recovers_on_bulk_retry(self, eight_cpu_devices):
+        """A fault that fires exactly once demotes the pipelined
+        attempt and the bulk retry completes — the product is served
+        by the blocked rung itself, bit-exact, with the demotion
+        accounted."""
+        csr = _csr(grid_topology(4))
+        n = int(csr.n_nodes)
+        dests = np.asarray([0, n - 1], dtype=np.int32)
+        mesh = blk.make_blocked_mesh(eight_cpu_devices)
+        ref_dist, ref_bitmap, _ = _blocked_product_mode(
+            csr, dests, mesh, 4, "0"
+        )
+        eng = blk.BlockedApspEngine(tile=4, mesh=mesh)
+        eng.pipeline_mode = "1"
+        fired = []
+
+        def hook(op):
+            if op == "blocked_round" and not fired:
+                fired.append(op)
+                raise RuntimeError("injected: partition mid-pipeline")
+
+        eng.fault_hook = hook
+        dist, bitmap, ok = eng.fleet_product(csr, dests, _out_ell(csr))
+        assert ok
+        assert eng.counters["mesh.blocked.pipeline_fallbacks"] == 1
+        assert eng.counters["mesh.blocked.products"] == 1
+        assert np.array_equal(np.asarray(jax.device_get(dist)), ref_dist)
+        assert np.array_equal(np.asarray(jax.device_get(bitmap)), ref_bitmap)
+
+
+class TestPipelineHloEvidence:
+    """Compile-only evidence on the virtual mesh: the lowered
+    `blocked_round_pipelined` module schedules the round-(k+1) panel
+    all-gathers with no data dependence on the round-k outer-update
+    while, so their async start/done spans legally bracket it —
+    materialized and verified by parallel.hlo_async from the compiled
+    module's real def-use chains."""
+
+    def _lowered_text(self, eight_cpu_devices, s=1, t=3, b=8):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = blk.make_blocked_mesh(eight_cpu_devices)
+        sds = jax.ShapeDtypeStruct
+        args = (
+            sds(
+                (s, t, b, t, b),
+                jnp.uint32,
+                sharding=NamedSharding(
+                    mesh, P("batch", None, "row", None, "col")
+                ),
+            ),
+            sds(
+                (s, b, t, b),
+                jnp.uint32,
+                sharding=NamedSharding(mesh, P("batch", None, None, "col")),
+            ),
+            sds(
+                (s, t, b, b),
+                jnp.uint32,
+                sharding=NamedSharding(mesh, P("batch", None, "row", None)),
+            ),
+            sds((t * b,), jnp.bool_, sharding=NamedSharding(mesh, P())),
+            sds((), jnp.int32, sharding=NamedSharding(mesh, P())),
+        )
+        return (
+            blk.blocked_round_pipelined.lower(*args, mesh=mesh)
+            .compile()
+            .as_text()
+        )
+
+    def test_async_spans_bracket_outer_update(self, eight_cpu_devices):
+        from openr_tpu.parallel import hlo_async
+
+        txt = self._lowered_text(eight_cpu_devices)
+        header = txt.split("\n", 1)[0]
+        assert "is_scheduled=true" in header
+        # donation survives the double-buffered carry: dist aliases
+        # output 0 in the compiled module
+        assert "input_output_alias={ {0}: (0" in header
+        rep = hlo_async.async_report(txt)
+        # the outer update is identifiable: the only rank-5 u32 while
+        assert rep["outer_update"] is not None
+        # row panel + col panel + diagonal replication
+        assert rep["n_collectives"] >= 3
+        # every span is legal per the def-use graph (checked, not
+        # assumed from the scheduler's construction)
+        assert all(s["legal"] for s in rep["spans"]), rep["spans"]
+        # headline: both PANEL gathers' spans bracket the outer update
+        assert rep["panel_overlap_ok"], rep["spans"]
+        spanning = [s for s in rep["spans"] if s["spans_outer_update"]]
+        assert len(spanning) >= 2
+        for s in spanning:
+            # the pair brackets real compute, not an empty window
+            assert len(s["compute_in_span"]) >= 1, s
+        assert rep["collective_bytes"] > 0
+        assert rep["overlap_frac_est"] > 0
+
+    def test_materialized_pairs_bracket_while_textually(
+        self, eight_cpu_devices
+    ):
+        from openr_tpu.parallel import hlo_async
+
+        txt = self._lowered_text(eight_cpu_devices)
+        rep = hlo_async.async_report(txt)
+        mat = rep["materialized"]
+        assert mat.count("all-gather-start(") == rep["n_collectives"]
+        assert mat.count("all-gather-done(") == rep["n_collectives"]
+        lines = mat.splitlines()
+        w = next(
+            i
+            for i, l in enumerate(lines)
+            if l.lstrip().startswith(f"%{rep['outer_update']} =")
+        )
+        spanning = [s for s in rep["spans"] if s["spans_outer_update"]]
+        for s in spanning:
+            si = next(
+                i
+                for i, l in enumerate(lines)
+                if l.lstrip().startswith(f"%{s['name']}-start =")
+            )
+            di = next(
+                i
+                for i, l in enumerate(lines)
+                if l.lstrip().startswith(f"%{s['name']} = ")
+            )
+            assert si < w < di, (s["name"], si, w, di)
+
+    def test_rejects_unscheduled_module(self, eight_cpu_devices):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from openr_tpu.parallel import hlo_async
+
+        mesh = blk.make_blocked_mesh(eight_cpu_devices)
+        sds = jax.ShapeDtypeStruct
+        lowered = blk.blocked_diag.lower(
+            sds(
+                (1, 2, 8, 2, 8),
+                jnp.uint32,
+                sharding=NamedSharding(
+                    mesh, P("batch", None, "row", None, "col")
+                ),
+            ),
+            sds((16,), jnp.bool_, sharding=NamedSharding(mesh, P())),
+            sds((), jnp.int32, sharding=NamedSharding(mesh, P())),
+            mesh=mesh,
+        )
+        with pytest.raises(ValueError, match="is_scheduled"):
+            hlo_async.parse_entry(lowered.as_text())

@@ -580,6 +580,61 @@ class TestCounterRegistrySweep:
             shim.wait_until_stopped(5)
         assert set(SERVING_COUNTER_KEYS) <= set(shimmed)
 
+    def test_pipeline_family_on_both_wire_surfaces(self, daemon):
+        """The pipelined blocked closure's ledger (prefetches issued,
+        rounds overlapped, demotions to bulk, the overlap-fraction
+        gauge) is pre-seeded in the blocked sub-registry, so the whole
+        mesh.blocked.pipeline_* family answers ONE getCounters on the
+        native ctrl server AND the fb303 shim before any closure ever
+        runs — the runbook's pipeline_fallbacks check needs no warm-up
+        query."""
+        import re
+
+        from openr_tpu.interop import thrift_binary as tb
+        from openr_tpu.interop.shim import ThriftBinaryShim
+        from openr_tpu.parallel.blocked import BLOCKED_COUNTER_KEYS
+        from test_thrift_binary import _call_ok
+
+        family = {k for k in BLOCKED_COUNTER_KEYS if ".pipeline_" in k}
+        assert family == {
+            "mesh.blocked.pipeline_rounds_overlapped",
+            "mesh.blocked.pipeline_prefetch_issues",
+            "mesh.blocked.pipeline_fallbacks",
+            "mesh.blocked.pipeline_overlap_frac_est",
+        }
+        name_re = re.compile(r"[a-z][a-z0-9_]*(\.[a-z0-9_]+)+\Z")
+        assert all(name_re.match(k) for k in family)
+
+        client = CtrlClient(port=daemon.ctrl_port)
+        try:
+            native = client.call("getCounters")
+        finally:
+            client.close()
+        assert family <= set(native)
+        assert all(native[k] == 0 for k in family)  # pre-seeded, untouched
+
+        shim = ThriftBinaryShim(
+            daemon.kvstore,
+            port=0,
+            node_name="solo",
+            counters_fn=daemon.ctrl_server.handler._all_counters,
+        )
+        shim.run()
+        try:
+            shimmed = _call_ok(
+                shim.port,
+                "getCounters",
+                47,
+                b"\x00",
+                ("map", tb.T_STRING, tb.T_I64),
+                dec=lambda m: {k.decode(): v for k, v in m.items()},
+            )
+        finally:
+            shim.stop()
+            shim.wait_until_stopped(5)
+        assert family <= set(shimmed)
+        assert all(shimmed[k] == 0 for k in family)
+
     def test_router_family_on_both_wire_surfaces(self, daemon):
         """The replica-fleet front door pre-seeds serving.router.* and
         rides the same two surfaces: a ctrl server whose serving module

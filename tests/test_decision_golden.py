@@ -2083,3 +2083,208 @@ class TestOcsOverlayEdges:
         # 2+2 injected slots, 2 swapped in place (retire+recycle share
         # a slot), 4 retired on the final push
         assert c["device.engine.rewire_slots"] >= 10
+
+
+def nh_weights(route) -> dict:
+    return {nh.neighbor_node_name: nh.weight for nh in route.nexthops}
+
+
+def wadj(me: str, other: str, metric: int = 10, weight: int = 1) -> Adjacency:
+    a = adj(me, other, metric=metric)
+    a.weight = weight
+    return a
+
+
+class TestUcmpWeightsPersistentPair:
+    """Ancestors: the DecisionTest Ucmp tranche (DecisionTestFixture.Ucmp
+    + SpfSolver weight-propagation cases) — ECMP next-hops stay
+    weightless, SP_UCMP_PREFIX_WEIGHT_PROPAGATION turns advertised
+    `PrefixEntry.weight` into gcd-normalized next-hop weights, and
+    SP_UCMP_ADJ_WEIGHT_PROPAGATION takes the first-hop adjacency
+    weight.  Ported onto ONE persistent dual-backend solver pair: every
+    advertise/re-weight/withdraw step rebuilds on the same host and
+    device solvers and asserts full route parity (NextHop equality
+    includes the weight field, so the device kernel must reproduce the
+    weights bit for bit, not just the next-hop set)."""
+
+    @staticmethod
+    def uentry(weight=None, algo=None):
+        return PrefixEntry(
+            prefix=PFX,
+            forwarding_algorithm=(
+                PrefixForwardingAlgorithm.SP_UCMP_PREFIX_WEIGHT_PROPAGATION
+                if algo is None
+                else algo
+            ),
+            weight=weight,
+        )
+
+    @staticmethod
+    def pair():
+        host = SpfSolver("1")
+        device = SpfSolver(
+            "1",
+            spf_backend=DeviceSpfBackend(
+                min_device_nodes=1, min_device_sources=1
+            ),
+        )
+        return host, device
+
+    def test_ecmp_next_hops_carry_no_weight(self):
+        """SP_ECMP baseline: the weight field stays 0 even when the
+        advertiser sets a prefix weight (the algorithm, not the entry
+        field, turns UCMP on)."""
+        db = routes(
+            "1",
+            {"0": square()},
+            prefix_state_with(("4", "0", PrefixEntry(prefix=PFX, weight=300))),
+        )
+        assert nh_weights(db.unicast_routes[PFX]) == {"2": 0, "3": 0}
+
+    def test_prefix_weight_propagation_lifecycle(self):
+        ls = square()
+        ps = PrefixState()
+        host, device = self.pair()
+        steps = 0
+
+        def check():
+            nonlocal steps
+            steps += 1
+            h = host.build_route_db({"0": ls}, ps)
+            d = device.build_route_db({"0": ls}, ps)
+            assert h.unicast_routes == d.unicast_routes, steps
+            assert h.mpls_routes == d.mpls_routes, steps
+            return h
+
+        # 1: anycast from 2 (w=400) and 3 (w=100), both one hop from
+        # 1 — weights normalize by gcd to 4:1
+        ps.update_prefix("2", "0", self.uentry(weight=400))
+        ps.update_prefix("3", "0", self.uentry(weight=100))
+        db = check()
+        assert nh_weights(db.unicast_routes[PFX]) == {"2": 4, "3": 1}
+
+        # 2: re-advertise 3 at w=200 on the SAME solver pair — the
+        # normalization follows (gcd 200 -> 2:1)
+        ps.update_prefix("3", "0", self.uentry(weight=200))
+        db = check()
+        assert nh_weights(db.unicast_routes[PFX]) == {"2": 2, "3": 1}
+
+        # 3: the heavier advertiser withdraws — the survivor normalizes
+        # to weight 1
+        ps.delete_prefix("2", "0", PFX)
+        db = check()
+        assert nh_weights(db.unicast_routes[PFX]) == {"3": 1}
+
+        # 4: both advertise with NO weight set: UCMP degrades to plain
+        # ECMP (weight 0) instead of black-holing the route
+        ps.update_prefix("2", "0", self.uentry())
+        ps.update_prefix("3", "0", self.uentry())
+        db = check()
+        assert nh_weights(db.unicast_routes[PFX]) == {"2": 0, "3": 0}
+
+        # 5: one advertiser downgrades to SP_ECMP — min-compatible
+        # algorithm selection turns the whole route back to ECMP even
+        # though the other still asks for UCMP with a weight
+        ps.update_prefix(
+            "2",
+            "0",
+            self.uentry(algo=PrefixForwardingAlgorithm.SP_ECMP),
+        )
+        ps.update_prefix("3", "0", self.uentry(weight=500))
+        db = check()
+        assert nh_weights(db.unicast_routes[PFX]) == {"2": 0, "3": 0}
+        assert steps == 5
+
+    def test_weights_restricted_to_min_metric_advertisers(self):
+        """A weighted advertiser that loses the metric race contributes
+        nothing: 2 is one hop away, 4 is two hops — only 2's weight
+        survives and normalizes to 1."""
+        db = routes(
+            "1",
+            {"0": square()},
+            prefix_state_with(
+                ("2", "0", self.uentry(weight=100)),
+                ("4", "0", self.uentry(weight=500)),
+            ),
+        )
+        assert nh_weights(db.unicast_routes[PFX]) == {"2": 1}
+
+    def test_shared_first_hop_accumulates_advertiser_weights(self):
+        """Two equal-distance advertisers behind one first-hop: the
+        next-hop accumulates both weights.  1-2, then 2-3 and 2-4 with
+        3 (w=100) and 4 (w=300) advertising — neighbor 2 carries
+        100+300, the direct advertiser 5 (w=400) on a parallel arm
+        matches it, so the pair normalizes to 1:1."""
+        ls = build_link_state(
+            {
+                "1": [adj("1", "2"), adj("1", "5", metric=20)],
+                "2": [adj("2", "1"), adj("2", "3"), adj("2", "4")],
+                "3": [adj("3", "2")],
+                "4": [adj("4", "2")],
+                "5": [adj("5", "1", metric=20)],
+            }
+        )
+        db = routes(
+            "1",
+            {"0": ls},
+            prefix_state_with(
+                ("3", "0", self.uentry(weight=100)),
+                ("4", "0", self.uentry(weight=300)),
+                ("5", "0", self.uentry(weight=400)),
+            ),
+        )
+        assert nh_weights(db.unicast_routes[PFX]) == {"2": 1, "5": 1}
+
+    def test_adj_weight_propagation_uses_first_hop_weights(self):
+        """SP_UCMP_ADJ_WEIGHT_PROPAGATION reads the local adjacency
+        weight, not the advertised prefix weight."""
+        ls = build_link_state(
+            {
+                "1": [wadj("1", "2", weight=6), wadj("1", "3", weight=2)],
+                "2": [adj("2", "1"), adj("2", "4")],
+                "3": [adj("3", "1"), adj("3", "4")],
+                "4": [adj("4", "2"), adj("4", "3")],
+            }
+        )
+        db = routes(
+            "1",
+            {"0": ls},
+            prefix_state_with(
+                (
+                    "4",
+                    "0",
+                    self.uentry(
+                        weight=999,  # ignored by adj propagation
+                        algo=(
+                            PrefixForwardingAlgorithm
+                            .SP_UCMP_ADJ_WEIGHT_PROPAGATION
+                        ),
+                    ),
+                )
+            ),
+        )
+        assert nh_weights(db.unicast_routes[PFX]) == {"2": 3, "3": 1}
+
+    def test_drained_weighted_advertiser_degrades_to_ecmp(self):
+        """The drain filter runs before weighting: when the only
+        weighted advertiser is overloaded, the surviving set has no
+        positive weight and ships as plain ECMP instead of a black
+        hole."""
+        ls = build_link_state(
+            {
+                "1": [adj("1", "2"), adj("1", "3")],
+                "2": [adj("2", "1"), adj("2", "4")],
+                "3": [adj("3", "1"), adj("3", "4")],
+                "4": [adj("4", "2"), adj("4", "3")],
+            },
+            overloaded={"2"},
+        )
+        db = routes(
+            "1",
+            {"0": ls},
+            prefix_state_with(
+                ("2", "0", self.uentry(weight=700)),
+                ("3", "0", self.uentry()),
+            ),
+        )
+        assert nh_weights(db.unicast_routes[PFX]) == {"3": 0}
