@@ -2105,6 +2105,39 @@ def bench_reconvergence_fabric5000() -> dict:
     )
 
 
+def bench_chaos_fuzz_smoke(n: int = 8, seed: int = 20260807) -> dict:
+    """Throughput of the coverage-guided chaos fuzzer's inner loop
+    (openr_tpu/chaos/fuzz.py): one small fixed-seed session, reporting
+    runs/s and the coverage the search discovered beyond its seed
+    timelines.  The row exists so a regression that slows the oracle
+    bundle (each run replays the full dispatch ladder + fleet + kv
+    fabric) or kills coverage growth shows up in the artifact, not just
+    as a slower soak."""
+    from openr_tpu.chaos.fuzz import FUZZ_COUNTERS, fuzz
+
+    c0 = FUZZ_COUNTERS.get_counters()
+    t0 = time.monotonic()
+    # leave the harness its exit slack; the session sheds inside itself
+    session = fuzz(n, seed=seed, budget_s=max(_budget_left() - 120, 30.0))
+    wall = time.monotonic() - t0
+    c1 = FUZZ_COUNTERS.get_counters()
+    ran = len(session.results)
+    hist = session.coverage_history
+    return {
+        "runs": ran,
+        "shed": session.shed,
+        "wall_s": round(wall, 3),
+        "runs_per_s": round(ran / wall, 3) if wall > 0 else None,
+        "coverage_tokens": hist[-1] if hist else 0,
+        "coverage_from_search": (hist[-1] - hist[2]) if len(hist) > 3 else 0,
+        "corpus_size": len(session.corpus),
+        "oracle_failures": (
+            c1["chaos.fuzz.oracle_failures"] - c0["chaos.fuzz.oracle_failures"]
+        ),
+        "note": f"fuzz(n={n}, seed={seed}); oracle bundle on every run",
+    }
+
+
 def bench_ksp2(
     dbs,
     name: str,
@@ -2938,6 +2971,8 @@ def main() -> None:
             "decision_cold_start_grid10000",
             lambda: bench_decision_cold_start(n_side=100, reps=3),
         ),
+        # chaos-fuzzer inner-loop throughput (oracle bundle per run)
+        ("chaos_fuzz_smoke", bench_chaos_fuzz_smoke),
     ):
         host_names.append(name)
         if _budget_left() < 60:

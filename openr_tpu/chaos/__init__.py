@@ -13,8 +13,22 @@ from .chaos import (
     FibChaosPlan,
     KvChaosInjector,
     LinkFaultProfile,
+    wait_timeout_scale,
 )
 from .flapstorm import FlapStormResult, FlapStormScenario
+
+# NOTE: the fuzz *loop* stays addressed as openr_tpu.chaos.fuzz.fuzz —
+# re-exporting the function here would shadow the submodule attribute
+from .fuzz import (
+    FUZZ_COUNTER_KEYS,
+    FUZZ_COUNTERS,
+    FuzzEvent,
+    FuzzSessionResult,
+    FuzzTimeline,
+    run_timeline,
+    seed_timeline,
+    shrink,
+)
 from .ocs import OcsController, OcsRewireResult
 from .overload import LoadReport, OpenLoopLoadGen
 from .replicafleet import (
@@ -38,6 +52,11 @@ __all__ = [
     "FibChaosPlan",
     "FlapStormResult",
     "FlapStormScenario",
+    "FUZZ_COUNTER_KEYS",
+    "FUZZ_COUNTERS",
+    "FuzzEvent",
+    "FuzzSessionResult",
+    "FuzzTimeline",
     "KvChaosInjector",
     "LinkFaultProfile",
     "LoadReport",
@@ -49,4 +68,8 @@ __all__ = [
     "fib_unicast_routes",
     "hold_converged",
     "oracle_route_dbs",
+    "run_timeline",
+    "seed_timeline",
+    "shrink",
+    "wait_timeout_scale",
 ]

@@ -15,6 +15,7 @@ replay comparison.
 from __future__ import annotations
 
 import logging
+import os
 import random
 import threading
 import time
@@ -415,8 +416,39 @@ class ChaosSpfBackend:
         return attr
 
 
+def wait_timeout_scale() -> float:
+    """Multiplier applied to every chaos wait/convergence timeout.
+
+    Timing model: chaos timeouts are calibrated on an UNINSTRUMENTED
+    1-CPU full-suite run — convergence is a fixed amount of daemon work
+    (SPF recomputes, queue drains, FIB programs), so wall time scales
+    with per-operation cost, not with the timeout constant.  Arming the
+    happens-before race detector (`OPENR_TSAN=1`) multiplies that
+    per-operation cost: every queue put/get, lock acquire, eventbase
+    handoff, and future resolution takes the detector's vector-clock
+    path, and under full-suite load the same scripted timeline can need
+    ~2-3x the wall clock to reach the identical converged state.  A
+    fixed timeout therefore turns instrumentation overhead into a fake
+    liveness failure — the replay-determinism flake — while scaling the
+    timeout (never the hold window or the poll cadence: quiescence
+    semantics must not change) keeps the pass condition identical and
+    only gives the slowed run time to get there.
+
+    `OPENR_CHAOS_TIMEOUT_SCALE` overrides for even slower rigs
+    (emulators, heavily shared CI); otherwise 3x whenever the detector
+    is armed, 1x unarmed so the calibrated budgets stay tight."""
+    env = os.environ.get("OPENR_CHAOS_TIMEOUT_SCALE")
+    if env:
+        return max(1.0, float(env))
+    from ..analysis import race
+
+    if race.TSAN is not None:
+        return 3.0
+    return 1.0
+
+
 def wait_until(cond, timeout_s: float = 20.0, poll_s: float = 0.05) -> bool:
-    deadline = time.monotonic() + timeout_s
+    deadline = time.monotonic() + timeout_s * wait_timeout_scale()
     while time.monotonic() < deadline:
         if cond():
             return True

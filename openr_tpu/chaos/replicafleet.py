@@ -461,15 +461,9 @@ class ReplicaFleetController:
 
         # dispatch ledger: first dispatches are the non-shed submissions,
         # and every re-dispatch is in exactly one named bucket
-        redispatch = (
-            counters["serving.router.retries"]
-            + counters["serving.router.hedges"]
-            + counters["serving.router.failovers"]
-            + counters["serving.router.epoch_reroutes"]
-        )
-        ledger_ok = counters["serving.router.dispatches"] == (
-            submitted - counters["serving.router.sheds"]
-        ) + redispatch
+        from ..serving.router import dispatch_ledger_closes
+
+        ledger_ok = dispatch_ledger_closes(counters, submitted)
 
         bit_exact = (
             acct["mismatches"] == 0 and acct["unknown_epochs"] == 0

@@ -15,7 +15,12 @@ import time
 from typing import Callable, Optional
 
 from ..decision.spf_solver import HostSpfBackend, SpfSolver
-from .chaos import SCENARIO_STREAM, ChaosEventLog, wait_until
+from .chaos import (
+    SCENARIO_STREAM,
+    ChaosEventLog,
+    wait_timeout_scale,
+    wait_until,
+)
 
 FIB_CLIENT = 786
 
@@ -81,7 +86,10 @@ def hold_converged(
     def _writes() -> tuple[int, ...]:
         return tuple(d.route_updates_queue.get_num_writes() for d in daemons)
 
-    deadline = time.monotonic() + timeout_s
+    # scale the SEARCH budget for instrumented/overridden runs, never
+    # the hold window: quiescence semantics must stay identical (see
+    # chaos.wait_timeout_scale's timing model)
+    deadline = time.monotonic() + timeout_s * wait_timeout_scale()
     while time.monotonic() < deadline:
         if not all(fib_matches_oracle(d) for d in daemons):
             time.sleep(0.05)

@@ -54,6 +54,7 @@ class OpenrCtrlHandler:
         serving=None,
         mesh=None,
         te=None,
+        fuzz=None,
         config=None,
         kvstore_updates_queue: Optional[ReplicateQueue[Publication]] = None,
         fib_updates_queue: Optional[ReplicateQueue] = None,
@@ -85,6 +86,9 @@ class OpenrCtrlHandler:
         # differentiable-TE optimizer (openr_tpu.te.TeOptimizer): exports
         # te.* counters (pre-seeded at construction) the same way
         self.te = te
+        # chaos fuzzer registry (openr_tpu.chaos.fuzz.FUZZ_COUNTERS):
+        # exports chaos.fuzz.* (pre-seeded zeros) the same way
+        self.fuzz = fuzz
         self.config = config
         self.kvstore_updates_queue = kvstore_updates_queue
         self.fib_updates_queue = fib_updates_queue
@@ -402,6 +406,7 @@ class OpenrCtrlHandler:
             self.serving,
             self.mesh,
             self.te,
+            self.fuzz,
         ):
             if module is None:
                 continue

@@ -51,6 +51,15 @@ from .spark import IoProvider, Spark, UdpIoProvider
 log = logging.getLogger(__name__)
 
 
+def _fuzz_counters():
+    """The chaos fuzzer's process-wide counter registry (chaos.fuzz.*,
+    pre-seeded zeros).  Imported lazily: the daemon hot path never needs
+    the fuzzer's harness machinery, only its counter surface."""
+    from .chaos.fuzz import FUZZ_COUNTERS
+
+    return FUZZ_COUNTERS
+
+
 class OpenrDaemon:
     def __init__(
         self,
@@ -367,6 +376,11 @@ class OpenrDaemon:
             # ride the same surface; the optimizer lives on the serving
             # backend so optimizeMetrics runs and counter reads agree
             te=getattr(self.serving.backend, "te", None),
+            # chaos fuzzer counters (chaos.fuzz.*, pre-seeded zeros at
+            # module import) ride the same surface: a daemon that never
+            # fuzzes still answers the whole family, and an in-process
+            # fuzz session's runs/shrinks are visible on both wires
+            fuzz=_fuzz_counters(),
             kvstore_updates_queue=self.kvstore_updates_queue,
             fib_updates_queue=self.fib_updates_queue,
             config_store=self.config_store,

@@ -17,6 +17,7 @@ from .router import (
     ReplicaRouter,
     ReplicaUnavailableError,
     SchedulerReplica,
+    dispatch_ledger_closes,
 )
 from .scheduler import (
     SERVING_COUNTER_KEYS,
@@ -35,6 +36,7 @@ __all__ = [
     "QueryShedError",
     "ReplicaRouter",
     "ReplicaUnavailableError",
+    "dispatch_ledger_closes",
     "ROUTER_COUNTER_KEYS",
     "SchedulerReplica",
     "SERVING_COUNTER_KEYS",

@@ -82,6 +82,28 @@ _GAUGE_KEYS = frozenset(
 _HEDGE_TICK_S = 0.005
 
 
+def dispatch_ledger_closes(counters: dict, submitted: int) -> bool:
+    """The router's exactly-closing dispatch identity (module docstring):
+
+        dispatches == (submitted - sheds)
+                      + retries + hedges + failovers + epoch_reroutes
+
+    `counters` is a `ReplicaRouter.get_counters()` snapshot taken AFTER
+    the router (and its replicas) stopped, so every callback's bumps are
+    visible; `submitted` is the caller-side count of queries handed to
+    `submit`.  Shared by the chaos replica-fleet scenario and the chaos
+    fuzzer's oracle bundle."""
+    redispatch = (
+        counters["serving.router.retries"]
+        + counters["serving.router.hedges"]
+        + counters["serving.router.failovers"]
+        + counters["serving.router.epoch_reroutes"]
+    )
+    return counters["serving.router.dispatches"] == (
+        submitted - counters["serving.router.sheds"]
+    ) + redispatch
+
+
 class ReplicaUnavailableError(RuntimeError):
     """The replica is down or unreachable (killed process, partition).
     Replica handles raise this (or resolve sub-futures with it) so the

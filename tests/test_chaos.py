@@ -35,7 +35,11 @@ from openr_tpu.chaos import (
     fib_unicast_routes,
     oracle_route_dbs,
 )
-from openr_tpu.chaos.chaos import SCENARIO_STREAM, wait_until
+from openr_tpu.chaos.chaos import (
+    SCENARIO_STREAM,
+    wait_timeout_scale,
+    wait_until,
+)
 from openr_tpu.chaos.scenario import hold_converged
 from openr_tpu.ctrl import OpenrCtrlHandler
 from openr_tpu.decision.spf_solver import HostSpfBackend
@@ -709,6 +713,45 @@ class TestChaosScenario:
         # same seed => same scripted timeline and same fault decisions
         assert log1.matches(log2), (log1.streams(), log2.streams())
         assert tables1 == tables2
+
+
+class TestWaitTimeoutScale:
+    """Regression for the replay-determinism flake: under OPENR_TSAN's
+    vector-clock instrumentation plus full-suite load, the scripted
+    scenario needs ~2-3x the wall clock to reach the identical converged
+    state, so the calibrated wait budgets must scale when the detector
+    is armed (and ONLY the search budgets — hold/poll semantics are
+    pinned by hold_converged itself)."""
+
+    def test_unarmed_default_is_identity(self, monkeypatch):
+        from openr_tpu.analysis import race
+
+        monkeypatch.delenv("OPENR_CHAOS_TIMEOUT_SCALE", raising=False)
+        monkeypatch.setattr(race, "TSAN", None)
+        assert wait_timeout_scale() == 1.0
+
+    def test_armed_detector_scales_the_wait_budget(self, monkeypatch):
+        from openr_tpu.analysis import race
+
+        monkeypatch.delenv("OPENR_CHAOS_TIMEOUT_SCALE", raising=False)
+        monkeypatch.setattr(race, "TSAN", object())
+        assert wait_timeout_scale() == 3.0
+
+        # the flake shape itself: a condition that flips at ~1.8x the
+        # nominal budget (instrumentation-slowed convergence) must still
+        # be reached by wait_until — unscaled it would time out
+        flip_at = time.monotonic() + 0.9
+        assert wait_until(lambda: time.monotonic() >= flip_at, timeout_s=0.5)
+
+    def test_env_override_wins_and_is_floored(self, monkeypatch):
+        from openr_tpu.analysis import race
+
+        monkeypatch.setattr(race, "TSAN", None)
+        monkeypatch.setenv("OPENR_CHAOS_TIMEOUT_SCALE", "5")
+        assert wait_timeout_scale() == 5.0
+        # a scale below 1 would silently tighten calibrated budgets
+        monkeypatch.setenv("OPENR_CHAOS_TIMEOUT_SCALE", "0.25")
+        assert wait_timeout_scale() == 1.0
 
 
 @pytest.mark.slow

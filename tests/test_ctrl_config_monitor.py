@@ -844,6 +844,63 @@ class TestCounterRegistrySweep:
         # representative key round-trips the strict-binary i64 map intact
         assert shimmed["te.runs"] == native["te.runs"]
 
+    def test_fuzz_family_on_both_wire_surfaces(self, daemon):
+        """The chaos-fuzzer ledger (runs, mutations, crossovers, novel
+        fingerprints, oracle failures, shrink steps) is pre-seeded in
+        its own process-wide registry and rides _all_counters like any
+        module, so the whole chaos.fuzz.* family answers ONE getCounters
+        on the native ctrl server AND the fb303 shim before any fuzz
+        session has run — a soak box's dashboard can alert on
+        oracle_failures going non-zero with no warm-up query."""
+        import re
+
+        from openr_tpu.chaos.fuzz import FUZZ_COUNTER_KEYS
+        from openr_tpu.interop import thrift_binary as tb
+        from openr_tpu.interop.shim import ThriftBinaryShim
+        from test_thrift_binary import _call_ok
+
+        family = set(FUZZ_COUNTER_KEYS)
+        assert {
+            "chaos.fuzz.runs",
+            "chaos.fuzz.mutations",
+            "chaos.fuzz.crossovers",
+            "chaos.fuzz.novel_fingerprints",
+            "chaos.fuzz.oracle_failures",
+            "chaos.fuzz.shrink_steps",
+        } == family
+        name_re = re.compile(r"[a-z][a-z0-9_]*(\.[a-z0-9_]+)+\Z")
+        assert all(name_re.match(k) for k in family)
+
+        client = CtrlClient(port=daemon.ctrl_port)
+        try:
+            native = client.call("getCounters")
+        finally:
+            client.close()
+        assert family <= set(native)
+
+        shim = ThriftBinaryShim(
+            daemon.kvstore,
+            port=0,
+            node_name="solo",
+            counters_fn=daemon.ctrl_server.handler._all_counters,
+        )
+        shim.run()
+        try:
+            shimmed = _call_ok(
+                shim.port,
+                "getCounters",
+                45,
+                b"\x00",
+                ("map", tb.T_STRING, tb.T_I64),
+                dec=lambda m: {k.decode(): v for k, v in m.items()},
+            )
+        finally:
+            shim.stop()
+            shim.wait_until_stopped(5)
+        assert family <= set(shimmed)
+        # the family round-trips the strict-binary i64 map intact
+        assert all(shimmed[k] == native[k] for k in family)
+
 
 class TestOptimizeMetricsWire:
     """The ctrl optimizeMetrics front-end end to end: a bad request is

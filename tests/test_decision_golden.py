@@ -956,6 +956,156 @@ class TestDuplicatePrefixTieBreaksPersistentPair:
         assert best.all_node_areas == {("3", "0"), ("4", "0")}
 
 
+class TestPartialSyncSequencesPersistentPair:
+    """Ancestors: DecisionTestFixture's incremental-publication cases
+    (DecisionTest.cpp adj-db update/withdraw sequences around :1400 and
+    the prefix-churn counterparts): the daemon never re-syncs the world
+    — it applies adjacency-only or prefix-only deltas to live state.
+    Ported onto ONE persistent dual-backend solver pair so each partial
+    step rebuilds on solvers carrying warm SPF/best-route caches from
+    the previous step, and parity (unicast + MPLS) must hold at every
+    intermediate state, not just the final one."""
+
+    @staticmethod
+    def _pair():
+        host = SpfSolver("1")
+        device = SpfSolver(
+            "1",
+            spf_backend=DeviceSpfBackend(
+                min_device_nodes=1, min_device_sources=1
+            ),
+        )
+
+        def check(ls, ps, step):
+            h = host.build_route_db({"0": ls}, ps)
+            d = device.build_route_db({"0": ls}, ps)
+            assert h.unicast_routes == d.unicast_routes, step
+            assert h.mpls_routes == d.mpls_routes, step
+            return h
+
+        return check
+
+    def test_adjacency_only_sequence_on_pinned_prefixes(self):
+        # prefixes are synced ONCE; every later step is an adjacency-
+        # only delta (metric change, link loss, node loss, node rejoin)
+        ls = square()
+        ps = prefix_state_with(("4", "0", PrefixEntry(prefix=PFX)))
+        check = self._pair()
+
+        db = check(ls, ps, "baseline")
+        assert nh_names(db.unicast_routes[PFX]) == {"2", "3"}
+
+        # 1: metric-only adj update — 1-2 worsens, path shifts via 3
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="1",
+                adjacencies=[adj("1", "2", metric=50), adj("1", "3")],
+                node_label=101,
+                area="0",
+            )
+        )
+        db = check(ls, ps, "worsen-1-2")
+        assert nh_names(db.unicast_routes[PFX]) == {"3"}
+
+        # 2: link loss — 3 drops its side of 3-4; the bidirectional
+        # check kills the link, forcing the long way around via 2
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="3",
+                adjacencies=[adj("3", "1")],
+                node_label=103,
+                area="0",
+            )
+        )
+        db = check(ls, ps, "drop-3-4")
+        route = db.unicast_routes[PFX]
+        assert nh_names(route) == {"2"}
+        assert all(nh.metric == 60 for nh in route.nexthops)
+
+        # 3: node loss — 2's adj db withdrawn entirely; the advertiser
+        # is unreachable and the route (and 4's label) must vanish
+        ls.delete_adjacency_database("2")
+        db = check(ls, ps, "lose-node-2")
+        assert PFX not in db.unicast_routes
+        assert 104 not in db.mpls_routes
+
+        # 4: rejoin + heal — 2 and 3 republish full adjacency sets and
+        # 1 restores its calibrated metric; ECMP comes back bit-exact
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="2",
+                adjacencies=[adj("2", "1"), adj("2", "4")],
+                node_label=102,
+                area="0",
+            )
+        )
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="3",
+                adjacencies=[adj("3", "1"), adj("3", "4")],
+                node_label=103,
+                area="0",
+            )
+        )
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="1",
+                adjacencies=[adj("1", "2"), adj("1", "3")],
+                node_label=101,
+                area="0",
+            )
+        )
+        db = check(ls, ps, "heal")
+        route = db.unicast_routes[PFX]
+        assert nh_names(route) == {"2", "3"}
+        assert all(nh.metric == 20 for nh in route.nexthops)
+        assert 104 in db.mpls_routes
+
+    def test_prefix_only_sequence_on_pinned_topology(self):
+        # the topology is synced ONCE; every later step is a prefix-
+        # only delta (advertise, second advertiser, withdraw, flip-back)
+        ls = square()
+        ps = PrefixState()
+        check = self._pair()
+
+        db = check(ls, ps, "empty")
+        assert PFX not in db.unicast_routes
+        assert 102 in db.mpls_routes  # labels come from topology alone
+
+        # 1: first advertiser appears on 4
+        ps.update_prefix("4", "0", PrefixEntry(prefix=PFX))
+        db = check(ls, ps, "advertise-4")
+        assert nh_names(db.unicast_routes[PFX]) == {"2", "3"}
+
+        # 2: a nearer advertiser joins on 2 — forwarding collapses to
+        # the closest advertiser without any topology event
+        ps.update_prefix("2", "0", PrefixEntry(prefix=PFX))
+        db = check(ls, ps, "advertise-2")
+        route = db.unicast_routes[PFX]
+        assert nh_names(route) == {"2"}
+        assert all(nh.metric == 10 for nh in route.nexthops)
+
+        # 3: the near advertiser withdraws — the far one takes back over
+        ps.delete_prefix("2", "0", PFX)
+        db = check(ls, ps, "withdraw-2")
+        assert nh_names(db.unicast_routes[PFX]) == {"2", "3"}
+
+        # 4: the last advertiser withdraws — the route vanishes while
+        # the label plane (topology-derived) is untouched
+        ps.delete_prefix("4", "0", PFX)
+        db = check(ls, ps, "withdraw-4")
+        assert PFX not in db.unicast_routes
+        assert 102 in db.mpls_routes and 104 in db.mpls_routes
+
+        # 5: flip-back on a different node — state from the withdrawn
+        # advertisers must not leak into the fresh advertisement
+        ps.update_prefix("3", "0", PrefixEntry(prefix=PFX))
+        db = check(ls, ps, "advertise-3")
+        route = db.unicast_routes[PFX]
+        assert nh_names(route) == {"3"}
+        assert all(nh.metric == 10 for nh in route.nexthops)
+
+
 class TestOrderedFibHolds:
     """Ancestor: the ordered-FIB hold machinery (HoldableValue,
     LinkState.cpp decrementHolds + DecisionTest hold coverage): route
