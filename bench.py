@@ -2360,6 +2360,80 @@ def bench_serving_load_wan100k(
     }
 
 
+def bench_trace_overhead_wan100k(
+    topo, clients: int = 6, qps_per_client: float = 30.0, duration_s: float = 2.0
+) -> dict:
+    """Span-tracing overhead on the wan100k serving path: the SAME
+    open-loop load twice — tracing unarmed (the shipped default: one
+    module-attribute load per seam), then armed at 1-in-8 sampling —
+    reporting the qps and p99 deltas.  The armed segment sheds whole
+    under OPENR_BENCH_BUDGET_S (an overhead row with only a baseline is
+    useless, so the baseline sheds too)."""
+    from openr_tpu.chaos.overload import OpenLoopLoadGen
+    from openr_tpu.obs import trace as _trace
+    from openr_tpu.serving import QueryScheduler
+
+    if _budget_left() < 3 * (3 * duration_s + 10):
+        return _shed_marker("trace_overhead_wan100k")
+
+    s_pad = 16
+    backend = _WanServingBackend(topo, s_pad)
+    backend.run_paths("0", list(range(s_pad)))
+    nodes = [int(s) for s in _wan_router_sources(topo)]
+    nodes += [int(x) for x in range(0, topo.n_nodes, topo.n_nodes // 64)]
+
+    def segment() -> dict:
+        sched = QueryScheduler(backend, max_pending=8192, max_coalesce=s_pad)
+        sched.run()
+        try:
+            gen = OpenLoopLoadGen(sched, nodes=nodes, seed=7, clients=clients)
+            report = gen.run_paced(
+                duration_s, qps_per_client, gather_timeout_s=300.0
+            )
+            return {
+                "sustained_qps": round(report.qps, 1),
+                "p50_us": report.pctl_us(50),
+                "p99_us": report.pctl_us(99),
+                "replied": report.replied,
+            }
+        finally:
+            sched.stop()
+
+    was_armed = _trace.TRACE is not None
+    _trace.disable()
+    try:
+        # throwaway warm segment: the first paced run pays dispatch-path
+        # warm-up (program cache, thread spin-up) that would otherwise
+        # land entirely in the unarmed baseline and bias the delta
+        segment()
+        off = segment()
+        tr = _trace.enable(sample_every=8, ring=512)
+        armed = segment()
+        obs_counters = tr.get_counters()
+    finally:
+        if not was_armed:
+            _trace.disable()
+
+    qps_delta_pct = (
+        round(100.0 * (off["sustained_qps"] - armed["sustained_qps"])
+              / off["sustained_qps"], 2)
+        if off["sustained_qps"] > 0
+        else None
+    )
+    return {
+        "clients": clients,
+        "offered_qps": round(clients * qps_per_client, 1),
+        "duration_s": duration_s,
+        "sample_every": 8,
+        "unarmed": off,
+        "armed": armed,
+        "qps_delta_pct": qps_delta_pct,
+        "p99_delta_us": armed["p99_us"] - off["p99_us"],
+        "traces_started": obs_counters["obs.traces_started"],
+        "spans_total": obs_counters["obs.spans_total"],
+    }
+
+
 def bench_serving_fleet_wan100k(
     topo,
     clients: int = 6,
@@ -2644,6 +2718,10 @@ DEVICE_ROWS = {
     # exact-solver acceptance gate vs host hill-climb at equal exact
     # evaluations (openr_tpu/te; docs/OPERATIONS.md "TE runbook")
     "te_wan100k": lambda t: bench_te_wan100k(t.wan),
+    # span-tracing overhead: the serving load row twice, unarmed vs
+    # armed at 1-in-8 sampling (qps/p99 delta; docs/OPERATIONS.md
+    # "Tracing runbook")
+    "trace_overhead_wan100k": lambda t: bench_trace_overhead_wan100k(t.wan),
 }
 
 DEVICE_NOTES = [

@@ -32,7 +32,11 @@ Bump sites recognized: ``*. _bump("lit", ...)`` calls and subscript
 writes into counters-like dicts (``...counters["lit"] = / +=``).  The
 ``stats()`` dict literals in ``runtime/queue.py`` are treated as synthetic
 ``queue.<name>.<key>`` counters, because ``queue_counters`` exports them
-verbatim under that prefix.
+verbatim under that prefix.  An ``export_histogram(counters, "<family>",
+hist)`` call (obs/histogram.py) is a bump site for each
+``<family>.p50_us/.p99_us/.p999_us`` percentile key it emits — the
+family argument must be a string LITERAL at the call site so the wire
+keys stay statically checkable.
 """
 
 from __future__ import annotations
@@ -183,6 +187,23 @@ def _collect_bumps(sf: SourceFile) -> list[BumpSite]:
                 and isinstance(node.args[0].value, str)
             ):
                 out.append(BumpSite(node.args[0].value, sf, node.args[0]))
+                continue
+            name = (
+                f.id
+                if isinstance(f, ast.Name)
+                else f.attr
+                if isinstance(f, ast.Attribute)
+                else None
+            )
+            if (
+                name == "export_histogram"
+                and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Constant)
+                and isinstance(node.args[1].value, str)
+            ):
+                fam = node.args[1].value
+                for suffix in ("p50_us", "p99_us", "p999_us"):
+                    out.append(BumpSite(f"{fam}.{suffix}", sf, node.args[1]))
         elif isinstance(node, (ast.Assign, ast.AugAssign)):
             targets = (
                 node.targets if isinstance(node, ast.Assign) else [node.target]

@@ -333,6 +333,14 @@ class _FuzzWorld:
         self._eng0 = self.engine.get_counters()
         self._blk0 = self.engine.blocked.get_counters()
 
+        # OPENR_TRACE: drain span-structure tokens accumulated by any
+        # EARLIER run so this timeline's fingerprint only sees its own
+        from ..obs import trace as _trace
+
+        tr = _trace.TRACE
+        if tr is not None:
+            tr.drain_structure_tokens()
+
         # kv satellite (lazy)
         self.kv_fabric = None
         self.kv_stores: list = []
@@ -1021,6 +1029,16 @@ class _FuzzWorld:
                 tokens.add(f"{key}:{d.bit_length()}")
         for op in self.fired:
             tokens.add(f"fault:{op}")
+        # span-tree structure as a novelty signal: a new retry/hedge edge
+        # or rung attribution shape counts as coverage even when every
+        # counter bucket is already known (determinism contract makes
+        # these byte-stable across same-seed replays)
+        from ..obs import trace as _trace
+
+        tr = _trace.TRACE
+        if tr is not None:
+            for t in tr.drain_structure_tokens():
+                tokens.add("span:" + t)
         return frozenset(tokens)
 
     def counter_deltas(self) -> dict:

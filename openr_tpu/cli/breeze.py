@@ -440,6 +440,48 @@ def cmd_monitor_counters(client: CtrlClient, args) -> None:
         print(f"{key} : {counters[key]}")
 
 
+def _print_span(span: dict, depth: int = 0) -> None:
+    tags = " ".join(f"{k}={v}" for k, v in sorted(span["tags"].items()))
+    dur = span["duration_us"]
+    dur_s = "?" if dur is None else f"{dur}us"
+    pad = "  " * depth
+    print(f"{pad}{span['name']} [{dur_s}]" + (f" {tags}" if tags else ""))
+    for child in sorted(span["children"], key=lambda c: c["t_offset_us"]):
+        _print_span(child, depth + 1)
+
+
+def cmd_monitor_traces(client: CtrlClient, args) -> None:
+    traces = client.call("dumpTraces", n=args.n)
+    if not traces:
+        print("no traces (is the daemon running with OPENR_TRACE=1?)")
+        return
+    for i, root in enumerate(traces):
+        if i:
+            print()
+        _print_span(root)
+
+
+def cmd_monitor_histograms(client: CtrlClient, args) -> None:
+    counters = client.call("getCounters")
+    families = sorted(
+        k[: -len(".p50_us")] for k in counters if k.endswith(".p50_us")
+    )
+    if not families:
+        print("no histogram families exported")
+        return
+    rows = [
+        [
+            fam,
+            counters.get(f"{fam}.hist_us.count", 0),
+            counters[f"{fam}.p50_us"],
+            counters.get(f"{fam}.p99_us", 0),
+            counters.get(f"{fam}.p999_us", 0),
+        ]
+        for fam in families
+    ]
+    _table(rows, ["Family", "Count", "p50 (us)", "p99 (us)", "p99.9 (us)"])
+
+
 def cmd_config(client: CtrlClient, args) -> None:
     _print_json(client.call("getRunningConfig"))
 
@@ -713,6 +755,11 @@ def build_parser() -> argparse.ArgumentParser:
     p = mon.add_parser("counters")
     p.add_argument("--regex", default="")
     p.set_defaults(fn=cmd_monitor_counters)
+    p = mon.add_parser("traces")
+    p.add_argument("-n", type=int, default=16)
+    p.set_defaults(fn=cmd_monitor_traces)
+    p = mon.add_parser("histograms")
+    p.set_defaults(fn=cmd_monitor_histograms)
 
     cfg = sub.add_parser("config").add_subparsers(dest="cmd")
     p = cfg.add_parser("show")

@@ -55,6 +55,7 @@ class OpenrCtrlHandler:
         mesh=None,
         te=None,
         fuzz=None,
+        obs=None,
         config=None,
         kvstore_updates_queue: Optional[ReplicateQueue[Publication]] = None,
         fib_updates_queue: Optional[ReplicateQueue] = None,
@@ -89,6 +90,10 @@ class OpenrCtrlHandler:
         # chaos fuzzer registry (openr_tpu.chaos.fuzz.FUZZ_COUNTERS):
         # exports chaos.fuzz.* (pre-seeded zeros) the same way
         self.fuzz = fuzz
+        # observability surface (openr_tpu.obs.ObsStats): exports obs.*
+        # trace counters (zeroed when unarmed) plus the dumpTraces /
+        # getSpanSamples methods below
+        self.obs = obs
         self.config = config
         self.kvstore_updates_queue = kvstore_updates_queue
         self.fib_updates_queue = fib_updates_queue
@@ -130,6 +135,13 @@ class OpenrCtrlHandler:
             "buildPackageVersion": OPENR_VERSION,
             "buildMode": "tpu",
         }
+        # -- observability (span traces; empty lists when unarmed) -----------
+        m["dumpTraces"] = lambda p: (
+            [] if self.obs is None else self.obs.dump_traces(p.get("n", 16))
+        )
+        m["getSpanSamples"] = lambda p: (
+            [] if self.obs is None else self.obs.span_samples(p.get("n", 32))
+        )
 
         # -- persistent config store (reference: set/get/eraseConfigKey,
         #    OpenrCtrlHandler.h:60-67 over PersistentStore)
@@ -407,6 +419,7 @@ class OpenrCtrlHandler:
             self.mesh,
             self.te,
             self.fuzz,
+            self.obs,
         ):
             if module is None:
                 continue

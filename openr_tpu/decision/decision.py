@@ -15,6 +15,7 @@ from typing import Optional
 
 log = logging.getLogger(__name__)
 
+from ..obs import trace as _trace
 from ..runtime.async_util import AsyncDebounce
 from ..runtime.eventbase import OpenrEventBase
 from ..runtime.queue import QueueClosedError, ReplicateQueue, RQueue
@@ -163,6 +164,10 @@ class Decision(OpenrEventBase):
         # the delta-updated product instead of dispatching against a
         # topology about to be invalidated
         self._pending_events = 0
+        # OPENR_TRACE: publication spans carried across kvstore_updates
+        # and awaiting the (debounced) rebuild that folds them in.
+        # Eventbase-thread only — no lock needed.
+        self._trace_pending: list = []
         self.counters: dict[str, int] = {}
 
     def _bump(self, counter: str, n: int = 1) -> None:
@@ -212,6 +217,9 @@ class Decision(OpenrEventBase):
                 pub = await self._kvstore_updates.aget()
             except QueueClosedError:
                 return
+            tr = _trace.TRACE
+            if tr is not None:
+                self._trace_pending.extend(tr.take_carried())
             self.process_publication(pub)
             if self.pending_updates.needs_route_update():
                 self._pending_events += 1
@@ -364,6 +372,26 @@ class Decision(OpenrEventBase):
         """Reference: rebuildRoutes (Decision.cpp:1866-1935)."""
         if self._cold_start_pending:
             return
+        tr = _trace.TRACE
+        pending, self._trace_pending = self._trace_pending, []
+        if tr is not None and pending:
+            # fan-in: the debounced rebuild folds every carried
+            # publication at once — open a "decision" stage under each
+            # and activate them all so the route push carries them on
+            spans = [
+                tr.child_open(sp, "decision", event=event)
+                for sp in dict.fromkeys(pending)
+            ]
+            try:
+                with tr.activate(spans):
+                    self._rebuild_routes_impl(event)
+            finally:
+                for sp in spans:
+                    sp.finish()
+            return
+        self._rebuild_routes_impl(event)
+
+    def _rebuild_routes_impl(self, event: str) -> None:
         self.pending_updates.add_event(event)
 
         try:

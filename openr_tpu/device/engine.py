@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import trace as _trace
 from ..ops import sssp as ops
 
 # source-batch padding ladder; above the last rung, next power of two
@@ -373,6 +374,9 @@ class DeviceResidencyEngine:
             if getattr(csr, "rewire_seq", 0) != res.rewire_seq:
                 try:
                     self._rewire_sync(res, csr)
+                    tr = _trace.TRACE
+                    if tr is not None:
+                        tr.annotate("engine.rung", "rewire")
                 except Exception:
                     # any rewire failure (log gap, fault injection, ...)
                     # demotes to the restage rung — never an error
@@ -380,6 +384,9 @@ class DeviceResidencyEngine:
                     res = self._restage(csr)
             if res.version != csr.version:
                 self._incremental(res, csr)
+                tr = _trace.TRACE
+                if tr is not None:
+                    tr.annotate("engine.rung", "incremental")
         self._bump(
             "device.engine.stage_us",
             int((time.perf_counter() - t0) * 1e6),
@@ -387,6 +394,9 @@ class DeviceResidencyEngine:
         return res
 
     def _restage(self, csr) -> _Resident:
+        tr = _trace.TRACE
+        if tr is not None:
+            tr.annotate("engine.rung", "restage")
         host_arrays = (
             csr.edge_src,
             csr.edge_dst,
@@ -639,6 +649,12 @@ class DeviceResidencyEngine:
             raise EpochMismatchError(int(expect_epoch), int(csr.version))
         if not sources:
             return {}
+        tr = _trace.TRACE
+        if tr is not None:
+            # rung taken by a serving dispatch: the warm path is "spf";
+            # sync() upgrades it to restage/rewire/incremental when the
+            # residency actually moved under this query
+            tr.annotate("engine.rung", "spf")
         t_query = time.perf_counter()
         bytes_before = self.counters["device.engine.bytes_staged"]
         res = self.sync(csr)
@@ -760,7 +776,18 @@ class DeviceResidencyEngine:
         real Pallas failure takes."""
         from ..ops import pallas_kernels as pk
 
-        return pk.run_with_fallback(
+        tr = _trace.TRACE
+        if tr is None:
+            return pk.run_with_fallback(
+                kind,
+                pallas_thunk,
+                xla_thunk,
+                counters=self.counters,
+                fault_hook=self.fault_hook,
+                mode=self.pallas_mode,
+            )
+        falls0 = self.counters.get("device.engine.pallas_fallbacks", 0)
+        out = pk.run_with_fallback(
             kind,
             pallas_thunk,
             xla_thunk,
@@ -768,6 +795,17 @@ class DeviceResidencyEngine:
             fault_hook=self.fault_hook,
             mode=self.pallas_mode,
         )
+        demoted = (
+            self.counters.get("device.engine.pallas_fallbacks", 0) > falls0
+        )
+        if self.pallas_mode == "off":
+            kernel = "xla"
+        elif demoted:
+            kernel = "fallback"
+        else:
+            kernel = "pallas"
+        tr.annotate("engine.kernel", f"{kind}:{kernel}")
+        return out
 
     # -- delta rung ----------------------------------------------------------
 
@@ -832,6 +870,9 @@ class DeviceResidencyEngine:
             else:
                 self._delta_buckets_seen.add(bucket_key)
                 self._bump("device.engine.delta_bucket_misses")
+        tr = _trace.TRACE
+        if tr is not None:
+            tr.annotate("engine.rung", "delta")
         t0 = time.perf_counter()
         try:
             return fn(*args, **kwargs)

@@ -28,6 +28,7 @@ from collections import deque
 from typing import Iterable, Optional, Protocol
 
 from ..decision.rib import DecisionRouteUpdate, RibMplsEntry, RibUnicastEntry
+from ..obs import trace as _trace
 from ..runtime.eventbase import OpenrEventBase
 from ..runtime.queue import QueueClosedError, ReplicateQueue, RQueue
 from ..types import MplsRoute, PerfEvents, UnicastRoute, add_perf_event
@@ -261,6 +262,27 @@ class Fib(OpenrEventBase):
                 update = await self._route_updates.aget()
             except QueueClosedError:
                 return
+            tr = _trace.TRACE
+            carried = tr.take_carried() if tr is not None else ()
+            if carried:
+                # flap-path terminal: program the routes under a
+                # "fib.program" stage on each carried span, then finish
+                # every trace root (the publication entered the ring here)
+                spans = [
+                    tr.child_open(sp, "fib.program") for sp in carried
+                ]
+                try:
+                    with tr.activate(spans):
+                        try:
+                            self.process_route_updates(update)
+                        except Exception:
+                            log.exception("fib: route update processing failed")
+                finally:
+                    for sp in spans:
+                        sp.finish()
+                    for sp in carried:
+                        tr.finish_root(sp)
+                continue
             try:
                 self.process_route_updates(update)
             except Exception:

@@ -41,6 +41,7 @@ from dataclasses import dataclass, field
 from typing import Any, Iterable, Optional, Protocol
 
 from ..runtime.eventbase import OpenrEventBase
+from ..obs import trace as _trace
 from ..runtime.queue import QueueClosedError, ReplicateQueue, RQueue
 from ..types import (
     FloodTopoSetParams,
@@ -893,6 +894,20 @@ class KvStoreDb:
         self, pub: Publication, sender_id: Optional[str] = None
     ) -> int:
         """Reference: mergePublication (KvStore.cpp)."""
+        tr = _trace.TRACE
+        if tr is not None:
+            # trace-context birth: a publication entering this node; the
+            # span rides the kvstore_updates queue into Decision and is
+            # finished by the Fib terminal once routes are programmed
+            root = tr.root("kvstore.publication", area=self.area)
+            if root is not None:
+                with tr.activate((root,)):
+                    return self._merge_publication_impl(pub, sender_id)
+        return self._merge_publication_impl(pub, sender_id)
+
+    def _merge_publication_impl(
+        self, pub: Publication, sender_id: Optional[str]
+    ) -> int:
         self._bump("kvstore.received_publications")
         self._bump("kvstore.received_key_vals", len(pub.key_vals))
 
@@ -908,12 +923,17 @@ class KvStoreDb:
             self._bump("kvstore.looped_publications")
             return 0
 
-        delta = Publication(
-            key_vals=merge_key_values(self.kv, pub.key_vals, self.store.filters),
-            flood_root_id=pub.flood_root_id,
-            area=self.area,
-            node_ids=list(pub.node_ids) if pub.node_ids is not None else None,
-        )
+        with _trace.maybe_child("kvstore.merge"):
+            delta = Publication(
+                key_vals=merge_key_values(
+                    self.kv, pub.key_vals, self.store.filters
+                ),
+                flood_root_id=pub.flood_root_id,
+                area=self.area,
+                node_ids=(
+                    list(pub.node_ids) if pub.node_ids is not None else None
+                ),
+            )
         kv_update_cnt = len(delta.key_vals)
         self._bump("kvstore.updated_key_vals", kv_update_cnt)
         self.update_ttl_countdown_queue(delta)
@@ -979,7 +999,19 @@ class KvStoreDb:
         pub.node_ids.append(self.store.node_id)
 
         # internal subscribers
-        self.store.kvstore_updates_queue.push(pub)
+        tr = _trace.TRACE
+        if tr is not None and not tr.scope():
+            # locally-originated publication (API origination, TTL
+            # expiry): the trace is born at the flood chokepoint instead
+            # of merge_publication
+            root = tr.root("kvstore.publication", area=self.area)
+            if root is not None:
+                with tr.activate((root,)):
+                    self.store.kvstore_updates_queue.push(pub)
+            else:
+                self.store.kvstore_updates_queue.push(pub)
+        else:
+            self.store.kvstore_updates_queue.push(pub)
         self._bump("kvstore.num_updates")
 
         if not pub.key_vals:

@@ -51,6 +51,15 @@ from .spark import IoProvider, Spark, UdpIoProvider
 log = logging.getLogger(__name__)
 
 
+def _obs_stats():
+    """The tracing surface (obs.* counters + dumpTraces/getSpanSamples).
+    ObsStats reads the tracer late-bound, so the daemon answers zeroed
+    counters and empty trace lists when OPENR_TRACE is off."""
+    from .obs import ObsStats
+
+    return ObsStats()
+
+
 def _fuzz_counters():
     """The chaos fuzzer's process-wide counter registry (chaos.fuzz.*,
     pre-seeded zeros).  Imported lazily: the daemon hot path never needs
@@ -381,6 +390,9 @@ class OpenrDaemon:
             # fuzzes still answers the whole family, and an in-process
             # fuzz session's runs/shrinks are visible on both wires
             fuzz=_fuzz_counters(),
+            # trace-span surface (obs.*, zeroed when OPENR_TRACE is off):
+            # same wire shape armed or not, plus dumpTraces/getSpanSamples
+            obs=_obs_stats(),
             kvstore_updates_queue=self.kvstore_updates_queue,
             fib_updates_queue=self.fib_updates_queue,
             config_store=self.config_store,
@@ -605,6 +617,7 @@ class ServingFleet:
             monitor=front.monitor,
             config=front.config,
             serving=self.router,
+            obs=_obs_stats(),
             queues=front._queues,
         )
 

@@ -1,0 +1,19 @@
+"""Observability: end-to-end span tracing + shared log-bucketed
+histograms (docs/ARCHITECTURE.md "Tracing & histograms").
+
+``obs.trace`` is imported late-bound by every seam (the OPENR_TSAN
+arming discipline); ``obs.histogram`` replaces the tree's ad-hoc
+percentile sites.  Neither imports jax.
+"""
+
+from .histogram import Histogram, export_histogram
+from .trace import OBS_COUNTER_KEYS, ObsStats, Span, Tracer
+
+__all__ = [
+    "Histogram",
+    "export_histogram",
+    "OBS_COUNTER_KEYS",
+    "ObsStats",
+    "Span",
+    "Tracer",
+]

@@ -80,6 +80,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obs import trace as _trace
+
 from ..ops import allsources as asrc
 
 # saturation sentinel: uint32 mirror of the int32 INF32 = 1 << 30 used
@@ -651,6 +653,9 @@ class BlockedApspEngine:
         lowering error) bumps `mesh.blocked.pipeline_fallbacks` and
         re-runs the bulk-synchronous loop from the host staging copy —
         safe even though the pipelined rounds donate dist."""
+        tr = _trace.TRACE
+        if tr is not None:
+            tr.annotate("engine.rung", "blocked")
         mesh = self.mesh()
         rows = mesh.shape["row"]
         cols = mesh.shape["col"]

@@ -17,6 +17,7 @@ import time
 from typing import Any, Awaitable, Callable, Coroutine, Optional
 
 from ..analysis import race as _race
+from ..obs import trace as _trace
 
 log = logging.getLogger(__name__)
 
@@ -27,6 +28,21 @@ def _tsan_handoff(fn: Callable[..., Any]) -> Callable[..., Any]:
     Identity when disarmed — a single module-attribute load."""
     det = _race.TSAN
     return fn if det is None else det.wrap_handoff(fn)
+
+
+def _obs_handoff(fn: Callable[..., Any]) -> Callable[..., Any]:
+    """OPENR_TRACE: carry the caller's active span scope across the same
+    thread handoff, so work marshalled onto a module loop keeps its
+    trace attribution.  Identity when disarmed (one attribute load) or
+    when the caller has no active scope."""
+    tr = _trace.TRACE
+    return fn if tr is None else tr.bind_scope(fn)
+
+
+def _handoff(fn: Callable[..., Any]) -> Callable[..., Any]:
+    """Compose the cross-thread wrappers (trace innermost so the TSAN
+    handoff edge brackets the whole marshalled closure)."""
+    return _tsan_handoff(_obs_handoff(fn))
 
 
 class Timeout:
@@ -146,7 +162,7 @@ class OpenrEventBase:
             self._loop.create_task(_graceful())
 
         try:
-            self._loop.call_soon_threadsafe(_tsan_handoff(_do_stop))
+            self._loop.call_soon_threadsafe(_handoff(_do_stop))
         except RuntimeError:
             return
         # Joining from the module's own loop thread would deadlock (the loop
@@ -193,7 +209,7 @@ class OpenrEventBase:
         def _create() -> None:
             self._track(self._loop.create_task(coro, name=name or "fiber"))
 
-        self._loop.call_soon_threadsafe(_tsan_handoff(_create))
+        self._loop.call_soon_threadsafe(_handoff(_create))
 
     def in_event_base_thread(self) -> bool:
         return threading.current_thread() is self._thread
@@ -223,7 +239,7 @@ class OpenrEventBase:
             except BaseException as e:  # noqa: BLE001
                 fut.set_exception(e)
 
-        self._loop.call_soon_threadsafe(_tsan_handoff(_call))
+        self._loop.call_soon_threadsafe(_handoff(_call))
         return fut
 
     async def run_async(self, coro: Awaitable[Any]) -> Any:
@@ -246,7 +262,7 @@ class OpenrEventBase:
         assert self._loop is not None
         token = Timeout()
         self._loop.call_soon_threadsafe(
-            _tsan_handoff(token._arm), self._loop, delay_s, _tsan_handoff(fn)
+            _handoff(token._arm), self._loop, delay_s, _handoff(fn)
         )
         return token
 

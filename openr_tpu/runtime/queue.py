@@ -23,6 +23,7 @@ from collections import deque
 from typing import Any, Callable, Generic, Iterable, Optional, TypeVar
 
 from ..analysis import race as _race
+from ..obs import trace as _trace
 
 T = TypeVar("T")
 
@@ -82,6 +83,10 @@ class RWQueue(Generic[T]):
         # get).  None until the detector is first armed; kept positionally
         # aligned under _lock.
         self._tsan_tokens: Optional[deque] = None
+        # OPENR_TRACE: per-item span-scope tokens, same discipline — the
+        # pushing thread's active trace scope rides next to the item and
+        # is re-adopted by whichever consumer pops it.
+        self._obs_tokens: Optional[deque] = None
 
     # -- write side ---------------------------------------------------------
 
@@ -97,6 +102,12 @@ class RWQueue(Generic[T]):
                     # first armed push, or items enqueued while disarmed:
                     # realign with null tokens (no HB claimed for those)
                     toks = self._tsan_tokens = deque([None] * len(self._items))
+            tr = _trace.TRACE
+            if tr is not None:
+                otoks = self._obs_tokens
+                if otoks is None or len(otoks) != len(self._items):
+                    # items enqueued while disarmed carry no trace context
+                    otoks = self._obs_tokens = deque([None] * len(self._items))
             if self._maxlen is not None and len(self._items) >= self._maxlen:
                 # bounded queue: shed the OLDEST item (routing deltas are
                 # superseded by later state; blocking the producer would
@@ -105,9 +116,13 @@ class RWQueue(Generic[T]):
                 self._num_overflows += 1
                 if det is not None:
                     toks.popleft()
+                if tr is not None:
+                    otoks.popleft()
             self._items.append(item)
             if det is not None:
                 toks.append(det.publish_token())
+            if tr is not None:
+                otoks.append(tr.carry())
             self._num_pushed += 1
             self._cond.notify()
             waiters, self._async_waiters = self._async_waiters, []
@@ -149,6 +164,21 @@ class RWQueue(Generic[T]):
             if det is not None and tok is not None:
                 det.acquire_token(tok)
 
+    def _obs_take(self) -> None:
+        """OPENR_TRACE: pop the head item's carried trace scope (called
+        under _lock, immediately before the matching _items.popleft());
+        the popping thread IS the consumer, so stashing it thread-local
+        hands it to the adoption point right after get() returns."""
+        otoks = self._obs_tokens
+        if otoks is not None and len(otoks) == len(self._items):
+            tok = otoks.popleft()
+            tr = _trace.TRACE
+            if tr is not None:
+                # set unconditionally (tok may be None): a pop must
+                # CLEAR any stale carried token from an earlier pop on
+                # this thread, or a later adopter would mis-attribute
+                tr.set_carried(tok)
+
     def get(self, timeout: Optional[float] = None) -> T:
         with self._cond:
             if not self._cond.wait_for(
@@ -159,6 +189,8 @@ class RWQueue(Generic[T]):
                 self._num_read += 1
                 if self._tsan_tokens is not None:
                     self._tsan_join()
+                if self._obs_tokens is not None:
+                    self._obs_take()
                 return self._items.popleft()
             raise QueueClosedError("queue closed")
 
@@ -168,6 +200,8 @@ class RWQueue(Generic[T]):
                 self._num_read += 1
                 if self._tsan_tokens is not None:
                     self._tsan_join()
+                if self._obs_tokens is not None:
+                    self._obs_take()
                 return self._items.popleft()
             if self._closed:
                 raise QueueClosedError("queue closed")
@@ -181,6 +215,8 @@ class RWQueue(Generic[T]):
                     self._num_read += 1
                     if self._tsan_tokens is not None:
                         self._tsan_join()
+                    if self._obs_tokens is not None:
+                        self._obs_take()
                     return self._items.popleft()
                 if self._closed:
                     raise QueueClosedError("queue closed")

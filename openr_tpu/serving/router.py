@@ -56,6 +56,8 @@ import time
 from typing import Any, Optional
 
 from ..device.engine import EpochMismatchError
+from ..obs import trace as _trace
+from ..obs.histogram import Histogram, export_histogram
 from ..utils.backoff import ExponentialBackoff
 from .scheduler import QueryResult, QueryShedError
 
@@ -76,7 +78,15 @@ ROUTER_COUNTER_KEYS = (
 # replica-scheduler gauges that must not be summed when aggregating the
 # fleet's counters onto one wire surface (max is the honest roll-up)
 _GAUGE_KEYS = frozenset(
-    ("serving.batch_occupancy", "serving.p50_us", "serving.p99_us")
+    (
+        "serving.batch_occupancy",
+        "serving.p50_us",
+        "serving.p99_us",
+        "serving.p999_us",
+        "serving.router.p50_us",
+        "serving.router.p99_us",
+        "serving.router.p999_us",
+    )
 )
 
 _HEDGE_TICK_S = 0.005
@@ -159,6 +169,8 @@ class _Call:
         "resolved",
         "hedge_launched",
         "lock",
+        "span",
+        "t_submit",
     )
 
     def __init__(self, op: str, kw: dict, area: str, session) -> None:
@@ -166,6 +178,8 @@ class _Call:
         self.kw = kw
         self.area = area
         self.session = session
+        self.span = None  # OPENR_TRACE root (None unarmed/sampled out)
+        self.t_submit = time.perf_counter()
         self.future: "concurrent.futures.Future[QueryResult]" = (
             concurrent.futures.Future()
         )
@@ -203,6 +217,9 @@ class ReplicaRouter:
         self.default_area = default_area
         self._lock = threading.Lock()
         self.counters: dict[str, int] = {k: 0 for k in ROUTER_COUNTER_KEYS}
+        # delivered-reply latency (submit -> future resolution), shared
+        # log2-bucket histogram -> serving.router.p50/p99/p999_us
+        self._hist = Histogram()
         # session -> pinned epoch (monotonically non-decreasing)
         self._sessions: dict[Any, int] = {}
         # test seam: when set to a list, every ACCEPTED (session, epoch)
@@ -248,6 +265,7 @@ class ReplicaRouter:
                     agg[k] = agg.get(k, 0) + int(v)
         with self._lock:
             agg.update(self.counters)
+        export_histogram(agg, "serving.router", self._hist)
         return agg
 
     # -- health ----------------------------------------------------------------
@@ -318,6 +336,9 @@ class ReplicaRouter:
             steps=steps,
         )
         call = _Call(op, kw, area, session)
+        tr = _trace.TRACE
+        if tr is not None:
+            call.span = tr.root("router.query", op=op)
         if self._stopped or not self._replicas:
             self._resolve_shed(call, "router stopped or no replicas")
             return call.future
@@ -400,7 +421,18 @@ class ReplicaRouter:
                 self._terminal(call, kind, last_exc, "no live replica")
                 return
             try:
-                fut = rep.handle.submit(call.op, **call.kw)
+                sp = call.span
+                tr = _trace.TRACE if sp is not None else None
+                if tr is not None:
+                    # the dispatch edge (first/retry/hedge/failover/
+                    # epoch_reroute) is structural; activating the call
+                    # span makes the replica scheduler's serving.query
+                    # span a child of this trace instead of a new root
+                    with tr.activate((sp,)):
+                        tr.event("dispatch", kind=kind)
+                        fut = rep.handle.submit(call.op, **call.kw)
+                else:
+                    fut = rep.handle.submit(call.op, **call.kw)
             except Exception as e:  # noqa: BLE001 — sync refusal = down
                 # no dispatch was issued: not in the ledger, but the
                 # replica is marked so the next pick skips it
@@ -498,6 +530,15 @@ class ReplicaRouter:
         if deliver:
             if hedged:
                 self._bump("serving.router.hedge_wins")
+            self._hist.record_us(
+                int((time.perf_counter() - call.t_submit) * 1e6)
+            )
+            sp = call.span
+            if sp is not None:
+                tr = _trace.TRACE
+                if tr is not None:
+                    sp.tags["outcome"] = "hedge_win" if hedged else "ok"
+                    tr.finish_root(sp)
             if not call.future.done():
                 call.future.set_result(res)
             return
@@ -539,6 +580,7 @@ class ReplicaRouter:
                 return
             call.resolved = True
         self._bump("serving.router.sheds")
+        self._trace_terminal(call, "shed")
         if not call.future.done():
             call.future.set_exception(QueryShedError(msg))
 
@@ -547,8 +589,18 @@ class ReplicaRouter:
             if call.resolved:
                 return
             call.resolved = True
+        self._trace_terminal(call, "error")
         if not call.future.done():
             call.future.set_exception(exc)
+
+    @staticmethod
+    def _trace_terminal(call: _Call, outcome: str) -> None:
+        sp = call.span
+        if sp is not None:
+            tr = _trace.TRACE
+            if tr is not None:
+                sp.tags["outcome"] = outcome
+                tr.finish_root(sp)
 
     # -- hedging ---------------------------------------------------------------
 

@@ -32,10 +32,11 @@ import concurrent.futures
 import logging
 import threading
 import time
-from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
+from ..obs import trace as _trace
+from ..obs.histogram import Histogram, export_histogram
 from ..runtime.eventbase import OpenrEventBase
 from ..runtime.queue import QueueClosedError, RWQueue
 
@@ -53,6 +54,7 @@ SERVING_COUNTER_KEYS = (
     "serving.batch_occupancy",
     "serving.p50_us",
     "serving.p99_us",
+    "serving.p999_us",
     "serving.deferrals",
 )
 
@@ -107,6 +109,11 @@ class _Pending:
     query: Query
     future: "concurrent.futures.Future[QueryResult]"
     t_submit: float
+    # OPENR_TRACE only: the query's root span and the stage-boundary
+    # timestamps the reply path turns into admission/coalesce children.
+    span: Any = None
+    t_drain: float = 0.0
+    t_stage: float = 0.0
 
 
 @dataclass
@@ -116,13 +123,6 @@ class _Batch:
     area: str
     epoch: int
     pendings: list = field(default_factory=list)
-
-
-def _pctl_us(sorted_us: list, p: int) -> int:
-    if not sorted_us:
-        return 0
-    i = min(len(sorted_us) - 1, (len(sorted_us) * p) // 100)
-    return int(sorted_us[i])
 
 
 class QueryScheduler(OpenrEventBase):
@@ -165,7 +165,9 @@ class QueryScheduler(OpenrEventBase):
         )
         self._lock = threading.Lock()
         self.counters: dict[str, int] = {k: 0 for k in SERVING_COUNTER_KEYS}
-        self._latencies_us: deque = deque(maxlen=2048)
+        # shared log2-bucket histogram: O(1) record, O(buckets) read —
+        # replaces the sorted(deque)-per-get_counters percentile snapshot
+        self._hist = Histogram()
         self._occupancy_sum = 0
         self._occupancy_batches = 0
         # every admitted-but-unanswered query; anything left here at
@@ -184,16 +186,14 @@ class QueryScheduler(OpenrEventBase):
     def get_counters(self) -> dict[str, int]:
         with self._lock:
             counters = dict(self.counters)
-            lats = sorted(self._latencies_us)
             occ_sum = self._occupancy_sum
             occ_n = self._occupancy_batches
         # derived gauges: mean batch occupancy in milli-queries-per-batch
-        # (integer wire format), latency percentiles over a sliding ring
+        # (integer wire format), latency percentiles from the histogram
         counters["serving.batch_occupancy"] = (
             (occ_sum * 1000) // occ_n if occ_n else 0
         )
-        counters["serving.p50_us"] = _pctl_us(lats, 50)
-        counters["serving.p99_us"] = _pctl_us(lats, 99)
+        export_histogram(counters, "serving", self._hist)
         return counters
 
     # -- admission (any thread) ----------------------------------------------
@@ -235,9 +235,15 @@ class QueryScheduler(OpenrEventBase):
             concurrent.futures.Future()
         )
         pending = _Pending(query, fut, time.perf_counter())
+        tr = _trace.TRACE
+        if tr is not None:
+            # trace-context birth: extends the router's span when one is
+            # active on this thread, else starts (and samples) a new root
+            pending.span = tr.root("serving.query", op=op)
         if not self._accepting or not self.admission.push(pending):
-            self._bump("serving.shed")
-            fut.set_exception(QueryShedError("admission closed"))
+            # _fail, not a bare set_exception: it also closes the trace
+            # span (outcome=shed) that was opened above
+            self._fail(pending, QueryShedError("admission closed"))
             return fut
         with self._lock:
             self._inflight.add(pending)
@@ -257,6 +263,14 @@ class QueryScheduler(OpenrEventBase):
             self._bump("serving.shed")
         else:
             self._bump("serving.errors")
+        sp = pending.span
+        if sp is not None:
+            tr = _trace.TRACE
+            if tr is not None:
+                sp.tags["outcome"] = (
+                    "shed" if isinstance(exc, QueryShedError) else "error"
+                )
+                tr.finish_root(sp)
         pending.future.set_exception(exc)
 
     # -- coalescing (event-base fiber) ---------------------------------------
@@ -302,6 +316,11 @@ class QueryScheduler(OpenrEventBase):
                     if nxt is None:
                         break
                     drained.append(nxt)
+                if _trace.TRACE is not None:
+                    t_drain = time.perf_counter()
+                    for pending in drained:
+                        if pending.span is not None:
+                            pending.t_drain = t_drain
                 # defer-on-pending-events: hold the round (bounded) while
                 # the decision layer still has unfolded topology events,
                 # so the epoch pinned below is the post-coalesce one —
@@ -338,6 +357,11 @@ class QueryScheduler(OpenrEventBase):
                 for batch in batches.values():
                     if self.trace_hook is not None:
                         self.trace_hook("stage", batch)
+                    if _trace.TRACE is not None:
+                        t_stage = time.perf_counter()
+                        for pending in batch.pendings:
+                            if pending.span is not None:
+                                pending.t_stage = t_stage
                     await self._staged.put(batch)
         except (QueueClosedError, asyncio.CancelledError):
             pass
@@ -372,9 +396,24 @@ class QueryScheduler(OpenrEventBase):
             attempts = (
                 1 if batch.op == "optimize_metrics" else _MAX_EPOCH_RETRIES
             )
+            tr = _trace.TRACE
+            d_spans: list = []
+            if tr is not None:
+                # one open "dispatch" child per traced query in the batch;
+                # activating them all lets ONE engine-rung annotation land
+                # on every coalesced query's tree (fan-in scope)
+                d_spans = [
+                    tr.child_open(p.span, "dispatch")
+                    for p in batch.pendings
+                    if p.span is not None
+                ]
             for _attempt in range(attempts):
                 try:
-                    per_query = self._run_batch(batch)
+                    if d_spans:
+                        with tr.activate(d_spans):
+                            per_query = self._run_batch(batch)
+                    else:
+                        per_query = self._run_batch(batch)
                     error = None
                     break
                 except EpochMismatchError as e:
@@ -382,6 +421,9 @@ class QueryScheduler(OpenrEventBase):
                     # re-pin the fresh epoch and recompute — coalesced
                     # work is invalidated, never served stale
                     self._bump("serving.invalidations")
+                    if d_spans:
+                        with tr.activate(d_spans):
+                            tr.event("epoch_retry")
                     batch.epoch = int(self.backend.epoch(batch.area))
                     error = e
                 except Exception as e:  # noqa: BLE001
@@ -390,6 +432,9 @@ class QueryScheduler(OpenrEventBase):
                     )
                     error = e
                     break
+            if d_spans:
+                for ds in d_spans:
+                    ds.finish()
             n = len(batch.pendings)
             with self._lock:
                 self.counters["serving.batches"] += 1
@@ -407,7 +452,10 @@ class QueryScheduler(OpenrEventBase):
                 latency_us = int((t_done - pending.t_submit) * 1e6)
                 with self._lock:
                     self._inflight.discard(pending)
-                    self._latencies_us.append(latency_us)
+                self._hist.record_us(latency_us)
+                sp = pending.span
+                if sp is not None and tr is not None:
+                    self._trace_reply(tr, pending, t_done)
                 if pending.future.done():
                     continue
                 self._bump("serving.replies")
@@ -422,6 +470,25 @@ class QueryScheduler(OpenrEventBase):
         finally:
             if self.trace_hook is not None:
                 self.trace_hook("execute_end", batch)
+
+    @staticmethod
+    def _trace_reply(tr, pending: _Pending, t_done: float) -> None:
+        """Turn the recorded stage boundaries into completed children and
+        close out the query's trace: admission -> coalesce -> dispatch ->
+        reply (the dispatch child was opened live in _execute so engine
+        rung annotations landed on it)."""
+        sp = pending.span
+
+        def us(t: float) -> int:
+            return int(t * 1e6)  # same clock as Span (perf_counter)
+
+        if pending.t_drain:
+            tr.stage(sp, "admission", us(pending.t_submit), us(pending.t_drain))
+            if pending.t_stage:
+                tr.stage(sp, "coalesce", us(pending.t_drain), us(pending.t_stage))
+        tr.stage(sp, "reply", us(t_done), us(t_done))
+        sp.tags["outcome"] = "ok"
+        tr.finish_root(sp)
 
     def _run_batch(self, batch: _Batch) -> list:
         """One backend call for the whole batch; returns per-query values

@@ -26,6 +26,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..obs import trace as _trace
 from ..runtime.eventbase import OpenrEventBase
 from ..runtime.queue import QueueClosedError, ReplicateQueue, RQueue
 from ..serializer import dumps, loads
@@ -660,20 +661,36 @@ class Spark(OpenrEventBase):
     def _publish_event(
         self, event_type: NeighborEventType, neighbor: SparkNeighbor
     ) -> None:
-        self._neighbor_updates_queue.push(
-            NeighborEvent(
-                event_type=event_type,
-                node_name=neighbor.node_name,
-                if_name=neighbor.if_name,
-                remote_if_name=neighbor.remote_if_name,
-                area=neighbor.area,
-                neighbor_addr_v6=neighbor.transport_addr_v6,
-                neighbor_addr_v4=neighbor.transport_addr_v4,
-                ctrl_port=neighbor.ctrl_port,
-                rtt_us=neighbor.rtt_us,
-                kvstore_port=neighbor.kvstore_port,
-            )
+        event = NeighborEvent(
+            event_type=event_type,
+            node_name=neighbor.node_name,
+            if_name=neighbor.if_name,
+            remote_if_name=neighbor.remote_if_name,
+            area=neighbor.area,
+            neighbor_addr_v6=neighbor.transport_addr_v6,
+            neighbor_addr_v4=neighbor.transport_addr_v4,
+            ctrl_port=neighbor.ctrl_port,
+            rtt_us=neighbor.rtt_us,
+            kvstore_port=neighbor.kvstore_port,
         )
+        tr = _trace.TRACE
+        if tr is not None:
+            # trace-context birth: a neighbor transition entering the
+            # module fabric.  The root is finished immediately after the
+            # push (shallow trace — downstream link-monitor work shows
+            # up as the kvstore publications it causes), so it lands in
+            # the ring even if no consumer adopts it.
+            root = tr.root(
+                "spark.neighbor_event",
+                event=event_type.name,
+                node=neighbor.node_name,
+            )
+            if root is not None:
+                with tr.activate((root,)):
+                    self._neighbor_updates_queue.push(event)
+                tr.finish(root)
+                return
+        self._neighbor_updates_queue.push(event)
 
     # -- public API (reference: Spark.h:99-105) ------------------------------
 

@@ -1,0 +1,50 @@
+#!/bin/bash
+# Recurring budgeted chaos-fuzz soak (docs/OPERATIONS.md "Chaos
+# fuzzing").  Runs the `-m slow` soak suite under a wall budget and a
+# logged (hence replayable) session seed; on failure the same seed is
+# re-run through the fuzzer CLI, which shrinks each violation and
+# deposits the reproducer under tests/chaos_corpus/ where tier-1
+# replays it forever once committed.
+#
+# Usage: fuzz_soak.sh [repo-dir]
+#
+# Environment (all optional):
+#   OPENR_FUZZ_BUDGET_S  wall budget for the soak (default 900); the
+#                        session sheds remaining runs loudly at the
+#                        deadline instead of being killed mid-timeline
+#   OPENR_FUZZ_SEED      session seed (default: days-since-epoch, so a
+#                        daily timer walks the seed space one seed per
+#                        day and any day's failure replays exactly)
+#   OPENR_TRACE          set to 1 to also feed span-tree structure
+#                        tokens into the coverage fingerprint
+
+set -euo pipefail
+
+REPO="${1:-$(cd "$(dirname "$0")/.." && pwd)}"
+cd "$REPO"
+
+: "${OPENR_FUZZ_BUDGET_S:=900}"
+: "${OPENR_FUZZ_SEED:=$(( $(date +%s) / 86400 ))}"
+export OPENR_FUZZ_BUDGET_S OPENR_FUZZ_SEED
+
+# the seed is the whole reproduction recipe — make it impossible to lose
+echo "fuzz_soak: seed=${OPENR_FUZZ_SEED} budget=${OPENR_FUZZ_BUDGET_S}s"
+
+python -m pytest tests/test_fuzz.py -m slow -q -p no:cacheprovider \
+    2>&1 | tee /tmp/openr-fuzz-soak.log
+status=${PIPESTATUS[0]}
+
+if [ "$status" -ne 0 ]; then
+    # replay the SAME seed through the CLI: sessions are deterministic,
+    # so the failures recur, get ddmin-shrunk, and land as committed-
+    # corpus candidates (contract: tests/chaos_corpus/README.md)
+    echo "fuzz_soak: FAILED (seed=${OPENR_FUZZ_SEED}); shrinking" \
+         "reproducers into tests/chaos_corpus/"
+    python -m openr_tpu.chaos.fuzz --fuzz-n 200 \
+        --seed "${OPENR_FUZZ_SEED}" \
+        --budget-s "${OPENR_FUZZ_BUDGET_S}" \
+        --out tests/chaos_corpus || true
+    echo "fuzz_soak: reproduce with OPENR_FUZZ_SEED=${OPENR_FUZZ_SEED}" \
+         "pytest tests/test_fuzz.py -m slow"
+fi
+exit "$status"

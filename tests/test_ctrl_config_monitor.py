@@ -901,6 +901,69 @@ class TestCounterRegistrySweep:
         # the family round-trips the strict-binary i64 map intact
         assert all(shimmed[k] == native[k] for k in family)
 
+    def test_obs_family_on_both_wire_surfaces(self, daemon):
+        """The tracing surface (ObsStats) answers the whole obs.*
+        family as ZEROS on the native ctrl server AND the fb303 shim
+        while OPENR_TRACE is off — the wire shape is arming-independent,
+        so a dashboard scraping obs.traces_finished needs no knowledge
+        of whether the box is armed.  The span dump RPCs answer empty
+        lists the same way.  The shared-histogram percentile gauges
+        (serving.p50_us et al) ride the serving family on the same two
+        surfaces."""
+        import re
+
+        from openr_tpu.interop import thrift_binary as tb
+        from openr_tpu.interop.shim import ThriftBinaryShim
+        from openr_tpu.obs import OBS_COUNTER_KEYS
+        from test_thrift_binary import _call_ok
+
+        family = set(OBS_COUNTER_KEYS)
+        assert {
+            "obs.traces_started",
+            "obs.traces_sampled_out",
+            "obs.traces_finished",
+            "obs.spans_total",
+            "obs.trace_ring_evictions",
+        } == family
+        name_re = re.compile(r"[a-z][a-z0-9_]*(\.[a-z0-9_]+)+\Z")
+        assert all(name_re.match(k) for k in family)
+
+        client = CtrlClient(port=daemon.ctrl_port)
+        try:
+            native = client.call("getCounters")
+            assert client.call("dumpTraces") == []
+            assert client.call("getSpanSamples") == []
+        finally:
+            client.close()
+        assert family <= set(native)
+        assert all(native[k] == 0 for k in family)  # unarmed: zeroed
+        # histogram percentile gauges ride the serving registry
+        for key in ("serving.p50_us", "serving.p99_us", "serving.p999_us"):
+            assert key in native, key
+
+        shim = ThriftBinaryShim(
+            daemon.kvstore,
+            port=0,
+            node_name="solo",
+            counters_fn=daemon.ctrl_server.handler._all_counters,
+        )
+        shim.run()
+        try:
+            shimmed = _call_ok(
+                shim.port,
+                "getCounters",
+                53,
+                b"\x00",
+                ("map", tb.T_STRING, tb.T_I64),
+                dec=lambda m: {k.decode(): v for k, v in m.items()},
+            )
+        finally:
+            shim.stop()
+            shim.wait_until_stopped(5)
+        assert family <= set(shimmed)
+        assert all(shimmed[k] == 0 for k in family)
+        assert "serving.p50_us" in shimmed
+
 
 class TestOptimizeMetricsWire:
     """The ctrl optimizeMetrics front-end end to end: a bad request is

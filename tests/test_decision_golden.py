@@ -2438,3 +2438,150 @@ class TestUcmpWeightsPersistentPair:
             ),
         )
         assert nh_weights(db.unicast_routes[PFX]) == {"3": 0}
+
+
+class TestMplsLabelSemanticsPersistentPair:
+    """Ancestors: SimpleRingTopologyFixture.IpToMplsLabelPrepend
+    (DecisionTest.cpp:2228) + the node-label pop cases around
+    Decision.cpp:655-745, stepped as prefix-only deltas on ONE
+    persistent dual-backend solver pair: prepend-label add / change /
+    remove / invalid must each rebuild correctly on warm caches, and
+    the label plane's pop semantics (POP_AND_LOOKUP at the label
+    owner, PHP at its neighbors, SWAP farther away) must hold at every
+    intermediate state."""
+
+    PREPEND = 60001
+
+    @staticmethod
+    def _pair():
+        host = SpfSolver("1")
+        device = SpfSolver(
+            "1",
+            spf_backend=DeviceSpfBackend(
+                min_device_nodes=1, min_device_sources=1
+            ),
+        )
+
+        def check(ls, ps, step):
+            h = host.build_route_db({"0": ls}, ps)
+            d = device.build_route_db({"0": ls}, ps)
+            assert h.unicast_routes == d.unicast_routes, step
+            assert h.mpls_routes == d.mpls_routes, step
+            return h
+
+        return check
+
+    @staticmethod
+    def entry(**kw) -> PrefixEntry:
+        return PrefixEntry(
+            prefix=PFX,
+            forwarding_type=PrefixForwardingType.SR_MPLS,
+            **kw,
+        )
+
+    def test_prepend_label_lifecycle_on_warm_pair(self):
+        # the topology is synced ONCE; each step only edits 4's prefix
+        # entry and the PUSH stack must track it exactly
+        ls = square()
+        ps = prefix_state_with(("4", "0", self.entry()))
+        check = self._pair()
+
+        db = check(ls, ps, "baseline")
+        route = db.unicast_routes[PFX]
+        assert nh_names(route) == {"2", "3"}
+        for nh in route.nexthops:
+            assert nh.mpls_action == MplsAction(
+                MplsActionCode.PUSH, push_labels=(104,)
+            )
+
+        # 1: prepend label appears — it rides FIRST in the push stack
+        ps.update_prefix("4", "0", self.entry(prepend_label=self.PREPEND))
+        db = check(ls, ps, "add-prepend")
+        for nh in db.unicast_routes[PFX].nexthops:
+            assert nh.mpls_action == MplsAction(
+                MplsActionCode.PUSH, push_labels=(self.PREPEND, 104)
+            )
+
+        # 2: prepend label changes value — no topology event, the warm
+        # rebuild must not serve the stale stack
+        ps.update_prefix(
+            "4", "0", self.entry(prepend_label=self.PREPEND + 1)
+        )
+        db = check(ls, ps, "change-prepend")
+        for nh in db.unicast_routes[PFX].nexthops:
+            assert nh.mpls_action == MplsAction(
+                MplsActionCode.PUSH, push_labels=(self.PREPEND + 1, 104)
+            )
+
+        # 3: prepend label goes invalid (> 20-bit) — isMplsLabelValid
+        # (DecisionTest.cpp:2343) empties the nexthop set but the entry
+        # itself still ships
+        ps.update_prefix(
+            "4", "0", self.entry(prepend_label=(1 << 20) + 7)
+        )
+        db = check(ls, ps, "invalid-prepend")
+        assert db.unicast_routes[PFX].nexthops == frozenset()
+
+        # 4: prepend label removed — the plain node-label stack returns
+        ps.update_prefix("4", "0", self.entry())
+        db = check(ls, ps, "remove-prepend")
+        route = db.unicast_routes[PFX]
+        assert nh_names(route) == {"2", "3"}
+        for nh in route.nexthops:
+            assert nh.mpls_action == MplsAction(
+                MplsActionCode.PUSH, push_labels=(104,)
+            )
+
+    def test_pop_semantics_track_topology_on_warm_pair(self):
+        # label plane derives from topology alone: own label pops,
+        # neighbor labels PHP, distant labels SWAP — and a topology
+        # delta that moves a node from distant to adjacent must flip
+        # its action on the warm pair
+        ls = square()
+        ps = prefix_state_with(("4", "0", self.entry()))
+        check = self._pair()
+
+        db = check(ls, ps, "baseline")
+        # own label: POP_AND_LOOKUP toward the lookup address
+        own = db.mpls_routes[101]
+        assert len(own.nexthops) == 1
+        (nh,) = own.nexthops
+        assert nh.address == "::"
+        assert nh.mpls_action == MplsAction(MplsActionCode.POP_AND_LOOKUP)
+        # neighbor label: penultimate hop pop, no swap label
+        for nh in db.mpls_routes[102].nexthops:
+            assert nh.mpls_action == MplsAction(MplsActionCode.PHP)
+            assert nh.mpls_action.swap_label is None
+        # distant label: SWAP carrying the same label toward both ECMP
+        # arms (4 is two hops away on either side of the square)
+        far = db.mpls_routes[104]
+        assert {nh.neighbor_node_name for nh in far.nexthops} == {"2", "3"}
+        for nh in far.nexthops:
+            assert nh.mpls_action == MplsAction(
+                MplsActionCode.SWAP, swap_label=104
+            )
+
+        # delta: 1 gains a direct adjacency to 4 — label 104 must flip
+        # from SWAP (distant) to PHP (adjacent) on the warm pair
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="1",
+                adjacencies=[adj("1", "2"), adj("1", "3"), adj("1", "4")],
+                node_label=101,
+                area="0",
+            )
+        )
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="4",
+                adjacencies=[adj("4", "2"), adj("4", "3"), adj("4", "1")],
+                node_label=104,
+                area="0",
+            )
+        )
+        db = check(ls, ps, "direct-1-4")
+        far = db.mpls_routes[104]
+        assert {nh.neighbor_node_name for nh in far.nexthops} == {"4"}
+        for nh in far.nexthops:
+            assert nh.mpls_action == MplsAction(MplsActionCode.PHP)
+            assert nh.mpls_action.swap_label is None
