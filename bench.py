@@ -2138,6 +2138,52 @@ def bench_chaos_fuzz_smoke(n: int = 8, seed: int = 20260807) -> dict:
     }
 
 
+def bench_sched_explore_smoke(budget_s: float = 30.0, seed: int = 0) -> dict:
+    """Throughput of the deterministic schedule explorer
+    (openr_tpu/analysis/sched.py): one budgeted library sweep (exhaustive
+    DPOR on the small scenarios, POS sampling on the rest), reporting
+    schedules/s and the DPOR prune ratio on the exhaustive pair.  The
+    row exists so a regression that slows the controlled scheduler's
+    round trip (every step is a cross-thread handoff) or weakens the
+    reduction (prune ratio collapsing toward 1x means DPOR degenerated
+    to naive enumeration) shows up in the artifact."""
+    from openr_tpu.analysis import sched
+
+    t0 = time.monotonic()
+    out = sched.tier1_smoke(
+        total_budget_s=min(budget_s, max(_budget_left() - 120, 10.0)),
+        seed=seed,
+    )
+    wall = time.monotonic() - t0
+    schedules = sum(r["schedules"] for r in out["scenarios"].values())
+    prunes = sum(r["prunes"] for r in out["scenarios"].values())
+    # reduction evidence on the exhaustive scenarios: explored vs the
+    # full interleaving count (explored + pruned sleep-set skips)
+    dpor = {
+        n: out["scenarios"][n]
+        for n in sched.EXHAUSTIVE_SCENARIOS
+        if n in out["scenarios"] and out["scenarios"][n]["complete"]
+    }
+    explored = sum(r["schedules"] for r in dpor.values())
+    return {
+        "scenarios": len(out["scenarios"]),
+        "shed": out["shed"],
+        "schedules": schedules,
+        "prunes": prunes,
+        "wall_s": round(wall, 3),
+        "schedules_per_s": round(schedules / wall, 3) if wall > 0 else None,
+        "dpor_certificates": sorted(dpor),
+        "dpor_prune_ratio": (
+            round((explored + sum(r["prunes"] for r in dpor.values()))
+                  / explored, 2)
+            if explored
+            else None
+        ),
+        "failures": len(out["failures"]),
+        "note": f"tier1_smoke(seed={seed}); unplanted library must be clean",
+    }
+
+
 def bench_ksp2(
     dbs,
     name: str,
@@ -3051,6 +3097,8 @@ def main() -> None:
         ),
         # chaos-fuzzer inner-loop throughput (oracle bundle per run)
         ("chaos_fuzz_smoke", bench_chaos_fuzz_smoke),
+        # schedule-explorer throughput + DPOR reduction evidence
+        ("sched_explore_smoke", bench_sched_explore_smoke),
     ):
         host_names.append(name)
         if _budget_left() < 60:

@@ -32,6 +32,14 @@ detector (analysis/race.py) and runs the given pytest expressions in a
 subprocess; the tsan_guard fixture fails any test whose run produced an
 unsuppressed race, so the usual exit-code contract holds (0 clean,
 1 findings, 2 infra failure).
+
+``--sched`` runs the deterministic schedule explorer (analysis/sched.py)
+over the scenario library: exhaustive DPOR with certificate on the
+smallest scenarios, POS sampling on the rest, under OPENR_SCHED_BUDGET_S.
+``--sched-replay <id>`` re-executes one schedule bit-identically;
+``--sched-shrink <id>`` ddmin-minimizes a failing schedule's choice
+string.  Setting OPENR_SCHED=1 in the environment implies ``--sched``.
+Same exit-code contract: 0 clean, 1 failing schedules, 2 infra failure.
 """
 
 from __future__ import annotations
@@ -158,10 +166,52 @@ def main(argv: list[str] | None = None) -> int:
             "the run with exit code 1"
         ),
     )
+    parser.add_argument(
+        "--sched",
+        action="store_true",
+        help=(
+            "run the deterministic schedule explorer over the scenario "
+            "library (DPOR + POS sampling under OPENR_SCHED_BUDGET_S); "
+            "OPENR_SCHED=1 in the environment implies this flag"
+        ),
+    )
+    parser.add_argument(
+        "--sched-replay",
+        metavar="SCHEDULE_ID",
+        help=(
+            "re-execute one schedule bit-identically from its id "
+            "(scenario[+plant]:s<seed>:<c0.c1...>); implies --sched"
+        ),
+    )
+    parser.add_argument(
+        "--sched-shrink",
+        metavar="SCHEDULE_ID",
+        help=(
+            "ddmin-minimize a failing schedule's choice string to the "
+            "shortest prefix-subsequence preserving the failure; "
+            "implies --sched"
+        ),
+    )
+    parser.add_argument(
+        "--sched-seed",
+        type=int,
+        default=0,
+        help="base seed for the --sched sampled-exploration passes",
+    )
     args = parser.parse_args(argv)
 
     if args.races:
         return _run_races(args.races)
+
+    if (
+        args.sched
+        or args.sched_replay
+        or args.sched_shrink
+        or os.environ.get("OPENR_SCHED", "") == "1"
+    ):
+        from . import sched as _sched
+
+        return _sched.run_cli(args)
 
     if args.list_rules:
         for rule, desc in sorted(ALL_RULES.items()):

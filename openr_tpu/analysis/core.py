@@ -191,6 +191,11 @@ ALL_RULES: dict[str, str] = {
         "consumer module stopped before its registered queue is closed "
         "(stop() can wedge on a get() nobody will ever wake)"
     ),
+    "blocking-call-in-eventbase": (
+        "unbounded blocking call (time.sleep / Future.result / Queue.get "
+        "without timeout) reachable from code running on a module's "
+        "event-base loop — one such call parks every fiber on the module"
+    ),
     # counter hygiene (openr_tpu/analysis/counters.py)
     "counter-name": "counter literal violates the module.name convention",
     "counter-registry": (
@@ -527,6 +532,7 @@ def run_analysis(
         "lock-order",
         "guarded-by",
         "thread-shutdown-order",
+        "blocking-call-in-eventbase",
     }
     if active & thread_rules:
         from . import threads

@@ -34,6 +34,19 @@ Three lock-discipline rules back the OPENR_TSAN dynamic detector
   ``self.Q.get_reader()``) must be closed in ``stop()`` *before* that
   consumer's ``stop()`` — otherwise shutdown can wedge on a ``get()``
   nobody will ever wake.  Today only convention enforces this ordering.
+
+One liveness rule guards the event-base loops themselves:
+
+- ``blocking-call-in-eventbase``: an unbounded blocking call —
+  ``time.sleep``, ``Future.result()`` with no timeout, ``Queue.get()``
+  with no timeout — inside code that runs ON a module's event-base
+  thread: any ``async def`` body (fiber tasks run on the loop) or any
+  callable marshalled via ``run_in_event_base_thread`` /
+  ``call_soon_threadsafe`` / ``schedule_timeout``.  Context propagates
+  through the intra-file call graph (``self.helper()`` / ``helper()``),
+  so a blocking call buried two helpers deep is still flagged.  One such
+  call parks the whole loop: every fiber, timer and heartbeat on that
+  module stalls until it returns — the watchdog fires on exactly this.
 """
 
 from __future__ import annotations
@@ -90,6 +103,7 @@ def check(
         _check_queue_registration(sf, reporter)
         _check_guarded_by(sf, reporter)
         _check_shutdown_order(sf, reporter)
+        _check_blocking_in_eventbase(sf, reporter)
         lock_edges.extend(_collect_lock_edges(sf))
     _check_lock_order(lock_edges, reporter, set(config.lock_order_exclude))
 
@@ -607,3 +621,208 @@ def _stop_method_events(
             if node.func.attr == "stop":
                 stop_lines.setdefault(owner, node.lineno)
     return close_lines, stop_lines
+
+
+# ---------------------------------------------------------------------------
+# blocking-call-in-eventbase: loop-liveness rule
+# ---------------------------------------------------------------------------
+
+#: APIs whose callable arguments execute on a module's event-base thread
+_MARSHAL_APIS = {
+    "run_in_event_base_thread",
+    "call_soon_threadsafe",
+    "schedule_timeout",
+}
+
+
+def _iter_own_body(fn: ast.AST):
+    """Yield nodes of a function body excluding nested def/class bodies
+    (those are separate call-graph nodes); lambdas are included — a
+    lambda closed over in a reachable body runs in the same context."""
+    body = getattr(fn, "body", fn)
+    stack = list(body) if isinstance(body, list) else [body]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _local_shadows(fn: ast.AST) -> set[str]:
+    """Names a function rebinds locally (parameters, assignments, local
+    import aliases): calls through them must NOT resolve to same-named
+    defs elsewhere in the file (`from x import what_if as run` would
+    otherwise alias the module's `run` method into the call graph)."""
+    shadows: set[str] = set()
+    args = getattr(fn, "args", None)
+    if args is not None:
+        for a in list(args.args) + list(args.posonlyargs) + list(args.kwonlyargs):
+            shadows.add(a.arg)
+        if args.vararg:
+            shadows.add(args.vararg.arg)
+        if args.kwarg:
+            shadows.add(args.kwarg.arg)
+    for node in _iter_own_body(fn):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                shadows.add(alias.asname or alias.name.split(".")[0])
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    shadows.add(tgt.id)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            if isinstance(node.target, ast.Name):
+                shadows.add(node.target.id)
+    return shadows
+
+
+def _awaited_calls(fn: ast.AST) -> set[int]:
+    """ids of Call nodes directly under an `await`: those suspend the
+    coroutine instead of blocking the loop (asyncio.Queue.get() vs
+    queue.Queue.get())."""
+    out: set[int] = set()
+    for node in _iter_own_body(fn):
+        if isinstance(node, ast.Await) and isinstance(node.value, ast.Call):
+            out.add(id(node.value))
+    return out
+
+
+def _timeout_unbounded(call: ast.Call) -> bool:
+    """True when the call can block forever: `.result()`, `.get()`,
+    `.result(None)`, `.get(timeout=None)`.  A variable timeout is given
+    the benefit of the doubt — only literal-None / absent is flagged."""
+    if call.args:
+        return len(call.args) == 1 and (
+            isinstance(call.args[0], ast.Constant) and call.args[0].value is None
+        )
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return isinstance(kw.value, ast.Constant) and kw.value.value is None
+    return True
+
+
+def _blocking_call(call: ast.Call, sleep_names: set[str]) -> str | None:
+    f = call.func
+    if isinstance(f, ast.Name) and f.id in sleep_names:
+        return "time.sleep()"
+    if not isinstance(f, ast.Attribute):
+        return None
+    if (
+        f.attr == "sleep"
+        and isinstance(f.value, ast.Name)
+        and f.value.id == "time"
+    ):
+        return "time.sleep()"
+    if f.attr == "result" and _timeout_unbounded(call):
+        return "Future.result() with no timeout"
+    if f.attr == "get" and not call.args and _timeout_unbounded(call):
+        # zero-positional-arg .get(): the queue idiom (dict.get takes a
+        # key); a bounded .get(timeout=5) passes
+        return "Queue.get() with no timeout"
+    return None
+
+
+def _check_blocking_in_eventbase(sf: SourceFile, reporter: Reporter) -> None:
+    # `from time import sleep` makes the bare name a blocking call too
+    sleep_names: set[str] = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name == "sleep":
+                    sleep_names.add(alias.asname or alias.name)
+
+    # every def in the file (any nesting), with its enclosing class
+    defs: list[tuple[ast.AST, str | None]] = []
+
+    def collect(node: ast.AST, cls: str | None) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                collect(child, child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.append((child, cls))
+                collect(child, cls)
+            else:
+                collect(child, cls)
+
+    collect(sf.tree, None)
+    by_name: dict[str, list[ast.AST]] = {}
+    for fn, _cls in defs:
+        by_name.setdefault(fn.name, []).append(fn)
+
+    # roots: async defs (fiber tasks run on the loop) + callables handed
+    # to the cross-thread marshal APIs; lambdas handed directly are
+    # scanned in place
+    reason: dict[int, str] = {}  # id(fn) -> why it runs on the loop
+    queue: list[ast.AST] = []
+    lambda_roots: list[tuple[ast.Lambda, str]] = []
+    for fn, _cls in defs:
+        if isinstance(fn, ast.AsyncFunctionDef):
+            reason[id(fn)] = f"fiber task `{fn.name}`"
+            queue.append(fn)
+    for node in ast.walk(sf.tree):
+        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+            continue
+        api = node.func.attr
+        if api not in _MARSHAL_APIS:
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Lambda):
+                lambda_roots.append((arg, api))
+                continue
+            cb: str | None = None
+            if isinstance(arg, ast.Name):
+                cb = arg.id
+            elif isinstance(arg, ast.Attribute) and _self_attr(arg) is not None:
+                cb = arg.attr
+            if cb is None:
+                continue
+            for fn in by_name.get(cb, ()):
+                if id(fn) not in reason:
+                    reason[id(fn)] = f"callback passed to {api}()"
+                    queue.append(fn)
+
+    # propagate through the intra-file call graph: `helper()` and
+    # `self.helper()` from a loop-context body put `helper` on the loop
+    while queue:
+        fn = queue.pop()
+        why = reason[id(fn)]
+        shadows = _local_shadows(fn)
+        for node in _iter_own_body(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee: str | None = None
+            if isinstance(node.func, ast.Name) and node.func.id not in shadows:
+                callee = node.func.id
+            elif isinstance(node.func, ast.Attribute) and _self_attr(node.func):
+                callee = node.func.attr
+            if callee is None:
+                continue
+            for target in by_name.get(callee, ()):
+                if id(target) not in reason:
+                    reason[id(target)] = f"`{target.name}` called from {why}"
+                    queue.append(target)
+
+    def scan(body_owner: ast.AST, why: str) -> None:
+        awaited = _awaited_calls(body_owner)
+        for node in _iter_own_body(body_owner):
+            if not isinstance(node, ast.Call) or id(node) in awaited:
+                continue
+            what = _blocking_call(node, sleep_names)
+            if what is not None:
+                reporter.emit(
+                    sf,
+                    "blocking-call-in-eventbase",
+                    node,
+                    f"blocking {what} runs on a module event-base thread "
+                    f"({why}); one blocked callback parks the loop — every "
+                    "fiber, timer and heartbeat on that module stalls.  "
+                    "Use await/aget(), a bounded timeout, or marshal the "
+                    "wait onto a worker thread",
+                )
+
+    for fn, _cls in defs:
+        if id(fn) in reason:
+            scan(fn, reason[id(fn)])
+    for lam, api in lambda_roots:
+        scan(lam, f"lambda passed to {api}()")

@@ -1264,6 +1264,7 @@ class FuzzSessionResult:
     coverage_history: list = field(default_factory=list)  # cumulative |tokens|
     failures: list = field(default_factory=list)  # oracle-violating results
     shed: int = 0  # runs dropped by the wall budget
+    sched_tokens: int = 0  # explorer tokens merged into the coverage map
 
     @property
     def coverage(self) -> int:
@@ -1278,17 +1279,33 @@ def fuzz(
     crossover_p: float = 0.33,
     n_seeds: int = 3,
     stop_on_failure: bool = False,
+    sched_n: int = 0,
 ) -> FuzzSessionResult:
     """Run `n` timelines: the seed corpus first, then mutants and
     crossovers of whatever earned corpus membership by novel coverage.
 
     `budget_s` > 0 bounds wall time: remaining runs are SHED LOUDLY
     (`result.shed`, stderr note) instead of letting a slow box time the
-    whole suite out — the bench.py budget discipline."""
+    whole suite out — the bench.py budget discipline.
+
+    `sched_n` > 0 additionally samples that many schedules from the
+    OPENR_SCHED explorer (analysis/sched.py) and merges their
+    ``sched:<scenario>:<choice-fingerprint>`` tokens into this session's
+    coverage map, so timeline search and schedule search share one
+    novelty frontier: a timeline is only "novel" if it reaches state no
+    explored schedule already witnessed, and vice versa."""
     rng = random.Random(seed)
     corpus = [seed_timeline(seed * 1000003 + i) for i in range(n_seeds)]
     session = FuzzSessionResult(seed=seed, requested=n)
     seen: set = set()
+    if sched_n > 0:
+        from ..analysis import sched as _sched
+
+        sched_tokens = _sched.sample_tokens(seed, n_schedules=sched_n)
+        if sched_tokens - seen:
+            seen |= sched_tokens
+            FUZZ_COUNTERS.bump("chaos.fuzz.novel_fingerprints")
+        session.sched_tokens = len(sched_tokens)
     deadline = time.monotonic() + budget_s if budget_s > 0 else None
     for i in range(n):
         if deadline is not None and time.monotonic() > deadline:
@@ -1395,6 +1412,48 @@ def shrink(
     )
 
 
+def shrink_preserving_coverage(
+    timeline: FuzzTimeline, tokens: frozenset
+) -> FuzzTimeline:
+    """Same ddmin chunk-removal skeleton as `shrink`, but the predicate
+    is coverage retention instead of oracle violation: a candidate
+    survives iff it still replays clean AND its fingerprint covers
+    `tokens`.  This is how clean-but-novel session timelines are
+    minimized before being checked into tests/chaos_corpus/ — the entry
+    keeps witnessing the exact coverage that earned it corpus
+    membership, at a fraction of the replay cost."""
+
+    def keeps(t: FuzzTimeline) -> bool:
+        FUZZ_COUNTERS.bump("chaos.fuzz.shrink_steps")
+        res = run_timeline(t)
+        return res.ok and tokens <= res.fingerprint
+
+    if not keeps(timeline):
+        raise ValueError(
+            "shrink_preserving_coverage: the input timeline does not "
+            "cover the requested tokens cleanly — nothing to preserve"
+        )
+    events = list(timeline.events)
+    gran = 2
+    while len(events) > 1:
+        chunk = -(-len(events) // gran)
+        reduced = False
+        for start in range(0, len(events), chunk):
+            cand = events[:start] + events[start + chunk :]
+            if not cand:
+                continue
+            if keeps(FuzzTimeline(seed=timeline.seed, events=cand)):
+                events = cand
+                gran = max(2, gran - 1)
+                reduced = True
+                break
+        if not reduced:
+            if gran >= len(events):
+                break
+            gran = min(len(events), 2 * gran)
+    return FuzzTimeline(seed=timeline.seed, events=events)
+
+
 # -- CLI ---------------------------------------------------------------------
 
 
@@ -1434,6 +1493,15 @@ def main(argv: Optional[list] = None) -> int:
         default="chaos_corpus",
         help="directory for shrunk reproducers",
     )
+    parser.add_argument(
+        "--sched-n",
+        type=int,
+        default=0,
+        help=(
+            "sample this many OPENR_SCHED schedules and merge their "
+            "coverage tokens into the session's novelty frontier"
+        ),
+    )
     args = parser.parse_args(argv)
 
     if args.shrink:
@@ -1450,13 +1518,19 @@ def main(argv: Optional[list] = None) -> int:
         return 0
 
     session = fuzz(
-        args.fuzz_n, seed=args.seed, budget_s=args.budget_s, plant=args.plant
+        args.fuzz_n,
+        seed=args.seed,
+        budget_s=args.budget_s,
+        plant=args.plant,
+        sched_n=args.sched_n,
     )
     ran = len(session.results)
     print(
         f"chaos.fuzz: {ran}/{session.requested} runs "
         f"(seed={args.seed}, shed={session.shed}), "
-        f"coverage={session.coverage} tokens, corpus={len(session.corpus)}, "
+        f"coverage={session.coverage} tokens "
+        f"({session.sched_tokens} from sched), "
+        f"corpus={len(session.corpus)}, "
         f"failures={len(session.failures)}"
     )
     if not session.failures:

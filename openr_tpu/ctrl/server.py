@@ -55,6 +55,7 @@ class OpenrCtrlHandler:
         mesh=None,
         te=None,
         fuzz=None,
+        sched=None,
         obs=None,
         config=None,
         kvstore_updates_queue: Optional[ReplicateQueue[Publication]] = None,
@@ -90,6 +91,9 @@ class OpenrCtrlHandler:
         # chaos fuzzer registry (openr_tpu.chaos.fuzz.FUZZ_COUNTERS):
         # exports chaos.fuzz.* (pre-seeded zeros) the same way
         self.fuzz = fuzz
+        # schedule-exploration registry (openr_tpu.analysis.sched
+        # .SCHED_COUNTERS): exports sched.* (pre-seeded zeros) the same way
+        self.sched = sched
         # observability surface (openr_tpu.obs.ObsStats): exports obs.*
         # trace counters (zeroed when unarmed) plus the dumpTraces /
         # getSpanSamples methods below
@@ -257,6 +261,13 @@ class OpenrCtrlHandler:
         m["getLinkMonitorState"] = lambda p: self._lm_state()
         m["setNodeOverload"] = lambda p: lm().set_node_overload(True)
         m["unsetNodeOverload"] = lambda p: lm().set_node_overload(False)
+        # soft-drain (reference: semiDrainNode / nodeMetricIncrementVal)
+        m["setNodeInterfaceMetricIncrease"] = lambda p: (
+            lm().set_node_metric_increment(p["metricIncrementVal"])
+        )
+        m["unsetNodeInterfaceMetricIncrease"] = lambda p: (
+            lm().set_node_metric_increment(0)
+        )
         m["setInterfaceOverload"] = lambda p: lm().set_link_overload(
             p["interface"], True
         )
@@ -419,6 +430,7 @@ class OpenrCtrlHandler:
             self.mesh,
             self.te,
             self.fuzz,
+            self.sched,
             self.obs,
         ):
             if module is None:

@@ -137,14 +137,20 @@ class Link:
         adj1: Adjacency,
         node2: str,
         adj2: Adjacency,
+        metric_inc1: int = 0,
+        metric_inc2: int = 0,
     ) -> None:
         self.area = area
         self.n1 = node1
         self.n2 = node2
         self.if1 = adj1.if_name
         self.if2 = adj2.if_name
-        self._metric1 = HoldableValue(adj1.metric)
-        self._metric2 = HoldableValue(adj2.metric)
+        # soft-drain: each endpoint's nodeMetricIncrementVal is folded into
+        # the metric it originates, so every consumer of metric_from_node()
+        # (host Dijkstra and the CSR device mirror alike) sees the drained
+        # cost without a separate lookup
+        self._metric1 = HoldableValue(adj1.metric + metric_inc1)
+        self._metric2 = HoldableValue(adj2.metric + metric_inc2)
         self._overload1 = HoldableValue(adj1.is_overloaded)
         self._overload2 = HoldableValue(adj2.is_overloaded)
         self.adj_label1 = adj1.adj_label
@@ -449,8 +455,25 @@ class LinkState:
                 and adj.other_if_name == other_adj.if_name
                 and adj.if_name == other_adj.other_if_name
             ):
-                return Link(self.area, node, adj, adj.other_node_name, other_adj)
+                return Link(
+                    self.area,
+                    node,
+                    adj,
+                    adj.other_node_name,
+                    other_adj,
+                    metric_inc1=self._metric_increment(node),
+                    metric_inc2=self._metric_increment(adj.other_node_name),
+                )
         return None
+
+    def _metric_increment(self, node: str) -> int:
+        """The node's current soft-drain increment (nodeMetricIncrementVal).
+        Looked up from the stored database so both sides of a link get their
+        own originator's value; update_adjacency_database stores the new db
+        before rebuilding links, so a drain change flows through the ordinary
+        metric diff (set_metric_from_node) and invalidates SPF memos."""
+        db = self._adjacency_databases.get(node)
+        return db.node_metric_increment_val if db is not None else 0
 
     def _get_ordered_link_set(self, adj_db: AdjacencyDatabase) -> list[Link]:
         links = []

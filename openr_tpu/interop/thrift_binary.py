@@ -587,6 +587,9 @@ ADJACENCY_DATABASE = StructSpec(
         Field(4, "node_label", T_I32, default=0),
         Field(5, "perf_events", ("struct", PERF_EVENTS), optional=True),
         Field(6, "area", T_STRING, dec=lambda b: b.decode(), default="0"),
+        # soft-drain increment (Types.thrift field 9); peers that predate
+        # the field simply omit it and decode to 0 (undrained)
+        Field(9, "node_metric_increment_val", T_I32, default=0),
     ),
 )
 

@@ -68,6 +68,10 @@ class LinkMonitorState:
     link_metric_overrides: dict[str, int] = field(default_factory=dict)
     node_label: int = 0
     adj_metric_overrides: dict[AdjKey, int] = field(default_factory=dict)
+    # soft-drain: added to every advertised adjacency metric
+    # (nodeMetricIncrementVal) — steers traffic away without the hard
+    # is_overloaded transit cutoff
+    node_metric_increment_val: int = 0
 
 
 CONFIG_KEY = "link-monitor-config"
@@ -207,6 +211,9 @@ class LinkMonitor(OpenrEventBase):
                         k: int(v) for k, v in d["link_metric_overrides"].items()
                     }
                     node_label = int(d.get("node_label", 0))
+                    node_metric_increment = int(
+                        d.get("node_metric_increment_val", 0)
+                    )
                     adj_metric_overrides = {}
                     for k, v in d.get("adj_metric_overrides", {}).items():
                         if_name, _, node = k.partition("|")
@@ -217,6 +224,7 @@ class LinkMonitor(OpenrEventBase):
                     self.state.overloaded_links = overloaded_links
                     self.state.link_metric_overrides = link_metric_overrides
                     self.state.node_label = node_label or self.state.node_label
+                    self.state.node_metric_increment_val = node_metric_increment
                     self.state.adj_metric_overrides = adj_metric_overrides
                     loaded = True
                 except Exception:
@@ -239,6 +247,9 @@ class LinkMonitor(OpenrEventBase):
                     "overloaded_links": sorted(self.state.overloaded_links),
                     "link_metric_overrides": self.state.link_metric_overrides,
                     "node_label": self.state.node_label,
+                    "node_metric_increment_val": (
+                        self.state.node_metric_increment_val
+                    ),
                     "adj_metric_overrides": {
                         f"{k[0]}|{k[1]}": v
                         for k, v in self.state.adj_metric_overrides.items()
@@ -515,6 +526,7 @@ class LinkMonitor(OpenrEventBase):
             is_overloaded=self.state.is_overloaded,
             node_label=self.state.node_label,
             area=area,
+            node_metric_increment_val=self.state.node_metric_increment_val,
         )
         if self.enable_perf_measurement:
             db.perf_events = PerfEvents()
@@ -581,6 +593,16 @@ class LinkMonitor(OpenrEventBase):
             lambda: setattr(self.state, "node_label", label)
         )
 
+    def set_node_metric_increment(self, increment: int) -> None:
+        """Soft-drain: advertise every adjacency with `increment` added to
+        its metric (reference: semi-/undrain-interface increments,
+        OpenrCtrlHandler::semiDrainNode).  0 restores normal costs."""
+        if increment < 0:
+            raise ValueError(f"negative metric increment {increment}")
+        self._update_and_advertise(
+            lambda: setattr(self.state, "node_metric_increment_val", increment)
+        )
+
     # -- introspection --------------------------------------------------------
 
     def get_interfaces(self) -> dict[str, InterfaceInfo]:
@@ -610,5 +632,6 @@ class LinkMonitor(OpenrEventBase):
                 link_metric_overrides=dict(self.state.link_metric_overrides),
                 node_label=self.state.node_label,
                 adj_metric_overrides=dict(self.state.adj_metric_overrides),
+                node_metric_increment_val=self.state.node_metric_increment_val,
             )
         ).result()

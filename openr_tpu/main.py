@@ -69,6 +69,15 @@ def _fuzz_counters():
     return FUZZ_COUNTERS
 
 
+def _sched_counters():
+    """The schedule explorer's process-wide counter registry (sched.*,
+    pre-seeded zeros).  Same contract as _fuzz_counters: a daemon that
+    never explores still answers the whole family on both wires."""
+    from .analysis.sched import SCHED_COUNTERS
+
+    return SCHED_COUNTERS
+
+
 class OpenrDaemon:
     def __init__(
         self,
@@ -390,6 +399,10 @@ class OpenrDaemon:
             # fuzzes still answers the whole family, and an in-process
             # fuzz session's runs/shrinks are visible on both wires
             fuzz=_fuzz_counters(),
+            # schedule-explorer counters (sched.*, pre-seeded zeros at
+            # module import) ride the same surface: exploration sessions'
+            # schedules/prunes/replays are visible on both wires
+            sched=_sched_counters(),
             # trace-span surface (obs.*, zeroed when OPENR_TRACE is off):
             # same wire shape armed or not, plus dumpTraces/getSpanSamples
             obs=_obs_stats(),
@@ -617,6 +630,7 @@ class ServingFleet:
             monitor=front.monitor,
             config=front.config,
             serving=self.router,
+            sched=_sched_counters(),
             obs=_obs_stats(),
             queues=front._queues,
         )

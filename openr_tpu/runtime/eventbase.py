@@ -17,6 +17,7 @@ import time
 from typing import Any, Awaitable, Callable, Coroutine, Optional
 
 from ..analysis import race as _race
+from ..analysis import sched as _sched
 from ..obs import trace as _trace
 
 log = logging.getLogger(__name__)
@@ -37,6 +38,15 @@ def _obs_handoff(fn: Callable[..., Any]) -> Callable[..., Any]:
     when the caller has no active scope."""
     tr = _trace.TRACE
     return fn if tr is None else tr.bind_scope(fn)
+
+
+def _sched_submit(eb: "OpenrEventBase") -> None:
+    """OPENR_SCHED: a cross-thread submit (run_in_event_base_thread /
+    add_fiber_task / schedule_timeout marshalling) is a yield point for
+    controlled tasks.  One module-attribute load when disarmed."""
+    sc = _sched.SCHED
+    if sc is not None:
+        sc.handoff(eb)
 
 
 def _handoff(fn: Callable[..., Any]) -> Callable[..., Any]:
@@ -205,6 +215,7 @@ class OpenrEventBase:
         """Schedule a long-running coroutine on this module's loop (from any
         thread). Reference: addFiberTask, OpenrEventBase.h:47."""
         assert self._loop is not None, f"{self.name} not started"
+        _sched_submit(self)
 
         def _create() -> None:
             self._track(self._loop.create_task(coro, name=name or "fiber"))
@@ -223,6 +234,7 @@ class OpenrEventBase:
         Re-entrant: from the owning thread the call runs inline (blocking on
         the future there would deadlock the loop)."""
         assert self._loop is not None, f"{self.name} not started"
+        _sched_submit(self)
         fut: concurrent.futures.Future = concurrent.futures.Future()
         if self.in_event_base_thread():
             try:
@@ -260,6 +272,7 @@ class OpenrEventBase:
         """Schedule fn after delay on this module's loop; returns a
         cancellable token (Spark-style hold timers reset constantly)."""
         assert self._loop is not None
+        _sched_submit(self)
         token = Timeout()
         self._loop.call_soon_threadsafe(
             _handoff(token._arm), self._loop, delay_s, _handoff(fn)

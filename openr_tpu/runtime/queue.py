@@ -23,6 +23,7 @@ from collections import deque
 from typing import Any, Callable, Generic, Iterable, Optional, TypeVar
 
 from ..analysis import race as _race
+from ..analysis import sched as _sched
 from ..obs import trace as _trace
 
 T = TypeVar("T")
@@ -91,6 +92,11 @@ class RWQueue(Generic[T]):
     # -- write side ---------------------------------------------------------
 
     def push(self, item: T) -> bool:
+        sc = _sched.SCHED
+        if sc is not None:
+            # OPENR_SCHED: declare the push as a yield point (same seam the
+            # TSAN put-token rides); no-op for uncontrolled threads
+            sc.queue_op(self, "queue.push")
         shed: Optional[T] = None
         with self._lock:
             if self._closed:
@@ -134,6 +140,9 @@ class RWQueue(Generic[T]):
         return True
 
     def close(self) -> None:
+        sc = _sched.SCHED
+        if sc is not None:
+            sc.queue_op(self, "queue.close")
         with self._lock:
             if self._closed:
                 return
@@ -180,6 +189,23 @@ class RWQueue(Generic[T]):
                 tr.set_carried(tok)
 
     def get(self, timeout: Optional[float] = None) -> T:
+        sc = _sched.SCHED
+        if sc is not None and sc.queue_get_gate(
+            self, lambda: bool(self._items) or self._closed
+        ):
+            # OPENR_SCHED serialized path: the gate granted us only once an
+            # item was available or the queue closed, and no other task can
+            # run between the grant and this pop (cond.wait would block the
+            # single-token world instead)
+            with self._lock:
+                if self._items:
+                    self._num_read += 1
+                    if self._tsan_tokens is not None:
+                        self._tsan_join()
+                    if self._obs_tokens is not None:
+                        self._obs_take()
+                    return self._items.popleft()
+                raise QueueClosedError("queue closed")
         with self._cond:
             if not self._cond.wait_for(
                 lambda: self._items or self._closed, timeout=timeout
@@ -195,6 +221,9 @@ class RWQueue(Generic[T]):
             raise QueueClosedError("queue closed")
 
     def try_get(self) -> Optional[T]:
+        sc = _sched.SCHED
+        if sc is not None:
+            sc.queue_op(self, "queue.get")
         with self._lock:
             if self._items:
                 self._num_read += 1
@@ -269,6 +298,11 @@ class ReplicateQueue(Generic[T]):
         self._maxlen = maxlen  # applied to each per-reader queue
 
     def push(self, item: T) -> bool:
+        sc = _sched.SCHED
+        if sc is not None:
+            # OPENR_SCHED: the fan-out itself is a yield point; each
+            # per-reader RWQueue.push below declares its own op too
+            sc.queue_op(self, "queue.push")
         with self._lock:
             if self._closed:
                 return False

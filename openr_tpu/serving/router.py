@@ -55,6 +55,7 @@ import threading
 import time
 from typing import Any, Optional
 
+from ..analysis import sched as _sched
 from ..device.engine import EpochMismatchError
 from ..obs import trace as _trace
 from ..obs.histogram import Histogram, export_histogram
@@ -339,6 +340,11 @@ class ReplicaRouter:
         tr = _trace.TRACE
         if tr is not None:
             call.span = tr.root("router.query", op=op)
+        sc = _sched.SCHED
+        if sc is not None:
+            # OPENR_SCHED: stop-latch read vs concurrent stop()/replica
+            # death — the router's schedule-sensitive dispatch window
+            sc.region("router.dispatch")
         if self._stopped or not self._replicas:
             self._resolve_shed(call, "router stopped or no replicas")
             return call.future

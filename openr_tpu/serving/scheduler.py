@@ -35,6 +35,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
+from ..analysis import sched as _sched
 from ..obs import trace as _trace
 from ..obs.histogram import Histogram, export_histogram
 from ..runtime.eventbase import OpenrEventBase
@@ -240,6 +241,11 @@ class QueryScheduler(OpenrEventBase):
             # trace-context birth: extends the router's span when one is
             # active on this thread, else starts (and samples) a new root
             pending.span = tr.root("serving.query", op=op)
+        sc = _sched.SCHED
+        if sc is not None:
+            # OPENR_SCHED: the accepting-latch read vs stop() is the
+            # scheduler's schedule-sensitive window (sched_shutdown_vs_future)
+            sc.region("serving.admission")
         if not self._accepting or not self.admission.push(pending):
             # _fail, not a bare set_exception: it also closes the trace
             # span (outcome=shed) that was opened above

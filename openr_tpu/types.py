@@ -88,6 +88,10 @@ class AdjacencyDatabase:
     node_label: int = 0
     area: str = "0"
     perf_events: Optional[PerfEvents] = None
+    # soft-drain (reference: nodeMetricIncrementVal, Types.thrift field 9):
+    # added to every adjacency metric this node originates, steering
+    # traffic away WITHOUT the hard is_overloaded transit cutoff
+    node_metric_increment_val: int = 0
 
 
 # ---------------------------------------------------------------------------

@@ -7,8 +7,11 @@ through — e.g. ``scripts/lint.py --changed-only`` for a fast pre-commit
 pass scoped to the files you touched (lock-order / guarded-by /
 thread-shutdown-order findings always survive the filter: they are
 whole-tree properties), ``scripts/lint.py --programs`` for the full
-jaxpr-contract audit, or ``scripts/lint.py --races tests/test_chaos.py``
-to run tests under the OPENR_TSAN dynamic race detector.
+jaxpr-contract audit, ``scripts/lint.py --races tests/test_chaos.py``
+to run tests under the OPENR_TSAN dynamic race detector, or
+``scripts/lint.py --sched`` for the deterministic schedule explorer
+(``--sched-replay``/``--sched-shrink`` take a schedule id).  Exit codes
+are uniform across all modes: 0 clean, 1 findings, 2 infra failure.
 """
 
 import sys

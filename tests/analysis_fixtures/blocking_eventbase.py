@@ -1,0 +1,79 @@
+"""Seeded blocking-call-in-eventbase violations for the analyzer
+self-tests.
+
+Parsed only, never imported.  Line numbers are asserted exactly in
+tests/test_analysis.py.
+"""
+
+import time
+from time import sleep
+
+
+class Module:
+    def __init__(self, queue, fut, loop):
+        self._queue = queue
+        self._fut = fut
+        self._loop = loop
+
+    # -- positives -----------------------------------------------------------
+
+    async def fiber(self):
+        time.sleep(0.1)  # line 21: blocking-call-in-eventbase (fiber task)
+        await self._queue.aget()
+
+    def start(self):
+        self.run_in_event_base_thread(self._callback)
+
+    def _callback(self):
+        return self._fut.result()  # line 28: via run_in_event_base_thread
+
+    def arm(self):
+        self.schedule_timeout(1.0, self._on_timer)
+
+    def _on_timer(self):
+        self._helper()
+
+    def _helper(self):
+        sleep(2)  # line 37: two hops deep from a schedule_timeout callback
+
+    def marshal(self):
+        self._loop.call_soon_threadsafe(lambda: self._queue.get())  # line 40
+
+    # -- suppressed ----------------------------------------------------------
+
+    async def known_block(self):
+        time.sleep(0)  # startup barrier  # openr: disable=blocking-call-in-eventbase
+
+    # -- clean ---------------------------------------------------------------
+
+    async def awaited_get(self):
+        # await suspends the coroutine; the loop keeps running
+        return await self._queue.get()
+
+    def _bounded(self):
+        self._fut.result(timeout=1.0)
+        return self._queue.get(timeout=5)
+
+    def bounded_callback(self):
+        self.run_in_event_base_thread(self._bounded)
+
+    def off_loop(self):
+        # never marshalled anywhere: blocking on a caller thread is fine
+        time.sleep(0.1)
+        return self._fut.result()
+
+    def run(self):
+        # blocking startup RPC from the CALLER thread (re-entrant inline
+        # on the loop thread); must stay clean
+        return self.run_in_event_base_thread(self._bounded).result(5.0)
+
+    def shadowed(self):
+        self.run_in_event_base_thread(self._alias_user)
+
+    def _alias_user(self):
+        from time import monotonic as run
+
+        return run()  # resolves to the import alias, NOT Module.run
+
+    def dict_get(self, d):
+        self.run_in_event_base_thread(lambda: d.get("key"))

@@ -148,6 +148,40 @@ class TestThreadRules:
         ]
 
 
+class TestBlockingInEventbaseRule:
+    """blocking-call-in-eventbase: unbounded blocking calls reachable from
+    loop-context code (async defs + marshalled callbacks), with intra-file
+    call-graph propagation and await/bounded/shadow precision."""
+
+    def test_seeded_violations_by_rule_and_line(self):
+        rep = _fixture_findings("blocking_eventbase.py")
+        # 21: time.sleep in an async fiber body
+        # 28: Future.result() in a run_in_event_base_thread callback
+        # 37: bare sleep() two call-graph hops from a schedule_timeout cb
+        # 40: Queue.get() inside a lambda handed to call_soon_threadsafe
+        assert _pairs(rep) == [
+            ("blocking-call-in-eventbase", 21),
+            ("blocking-call-in-eventbase", 28),
+            ("blocking-call-in-eventbase", 37),
+            ("blocking-call-in-eventbase", 40),
+        ]
+
+    def test_suppression_is_honored(self):
+        rep = _fixture_findings("blocking_eventbase.py")
+        assert [(s.rule, s.line) for s in rep.suppressed] == [
+            ("blocking-call-in-eventbase", 45)
+        ]
+
+    def test_clean_constructs_not_flagged(self):
+        # awaited .get() (49-51), bounded timeouts (53-55), caller-thread
+        # blocking incl. the startup-RPC .result(5.0) idiom (59-68), a
+        # local import alias shadowing a method name (70-76), and
+        # dict.get with a key argument (78-79) must all stay silent
+        rep = _fixture_findings("blocking_eventbase.py")
+        flagged = {line for _, line in _pairs(rep)}
+        assert not flagged & set(range(47, 80))
+
+
 class TestCounterRules:
     def test_seeded_violations_by_rule_and_line(self):
         rep = _fixture_findings("counter_violations.py")

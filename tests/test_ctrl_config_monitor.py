@@ -433,6 +433,10 @@ class TestCounterRegistrySweep:
                 # the TE optimizer pre-seeds te.* at construction, so the
                 # family is dumpable before any optimizeMetrics runs
                 "te.runs",
+                # the schedule explorer pre-seeds sched.* at module
+                # import, so the family is dumpable before any run
+                "sched.schedules_explored",
+                "sched.planted_finds",
             ):
                 assert key in counters, f"{key} missing from getCounters"
 
@@ -890,6 +894,67 @@ class TestCounterRegistrySweep:
                 shim.port,
                 "getCounters",
                 45,
+                b"\x00",
+                ("map", tb.T_STRING, tb.T_I64),
+                dec=lambda m: {k.decode(): v for k, v in m.items()},
+            )
+        finally:
+            shim.stop()
+            shim.wait_until_stopped(5)
+        assert family <= set(shimmed)
+        # the family round-trips the strict-binary i64 map intact
+        assert all(shimmed[k] == native[k] for k in family)
+
+    def test_sched_family_on_both_wire_surfaces(self, daemon):
+        """The schedule-explorer ledger (schedules explored, DPOR prunes,
+        replays, shrinks, planted-bug finds) is pre-seeded in its own
+        process-wide registry and rides _all_counters like chaos.fuzz,
+        so the whole sched.* family answers ONE getCounters on the
+        native ctrl server AND the fb303 shim before any exploration has
+        run — a CI box can alert on planted_finds staying zero (the
+        canary bug was not found) with no warm-up query."""
+        import re
+
+        from openr_tpu.analysis.sched import SCHED_COUNTER_KEYS, SchedCounters
+        from openr_tpu.interop import thrift_binary as tb
+        from openr_tpu.interop.shim import ThriftBinaryShim
+        from test_thrift_binary import _call_ok
+
+        family = set(SCHED_COUNTER_KEYS)
+        assert {
+            "sched.schedules_explored",
+            "sched.dpor_prunes",
+            "sched.replays",
+            "sched.shrinks",
+            "sched.planted_finds",
+        } == family
+        name_re = re.compile(r"[a-z][a-z0-9_]*(\.[a-z0-9_]+)+\Z")
+        assert all(name_re.match(k) for k in family)
+        # construction pre-seeds every key to zero (the process-wide
+        # singleton the daemon exports may have been bumped by an earlier
+        # in-process exploration, so the zero contract is asserted on a
+        # fresh registry)
+        assert SchedCounters().get_counters() == {k: 0 for k in family}
+
+        client = CtrlClient(port=daemon.ctrl_port)
+        try:
+            native = client.call("getCounters")
+        finally:
+            client.close()
+        assert family <= set(native)
+
+        shim = ThriftBinaryShim(
+            daemon.kvstore,
+            port=0,
+            node_name="solo",
+            counters_fn=daemon.ctrl_server.handler._all_counters,
+        )
+        shim.run()
+        try:
+            shimmed = _call_ok(
+                shim.port,
+                "getCounters",
+                46,
                 b"\x00",
                 ("map", tb.T_STRING, tb.T_I64),
                 dec=lambda m: {k.decode(): v for k, v in m.items()},

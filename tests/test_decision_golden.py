@@ -2440,6 +2440,171 @@ class TestUcmpWeightsPersistentPair:
         assert nh_weights(db.unicast_routes[PFX]) == {"3": 0}
 
 
+class TestDrainLifecyclePersistentPair:
+    """Ancestors: SimpleRingTopologyFixture.OverloadNodeTest (:2974) +
+    the semi-drain cases around nodeMetricIncrementVal
+    (DecisionTest's drained-metric goldens), stepped as ONE lifecycle:
+    hard drain (is_overloaded, a transit cutoff), soft drain
+    (node_metric_increment_val folded into every metric the node
+    originates — proportional steering, not a cutoff), and recovery,
+    all replayed through update_adjacency_database on one LinkState
+    against ONE persistent dual-backend solver pair with full route
+    parity at every step."""
+
+    @staticmethod
+    def pair():
+        host = SpfSolver("1")
+        device = SpfSolver(
+            "1",
+            spf_backend=DeviceSpfBackend(
+                min_device_nodes=1, min_device_sources=1
+            ),
+        )
+        return host, device
+
+    # square() neighbor map, used to re-advertise one node's db with new
+    # drain state while keeping its adjacencies bit-identical
+    SQUARE = {
+        "1": ("2", "3"),
+        "2": ("1", "4"),
+        "3": ("1", "4"),
+        "4": ("2", "3"),
+    }
+
+    @classmethod
+    def readvertise(cls, ls, node, inc=0, overloaded=False):
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name=node,
+                adjacencies=[adj(node, o) for o in cls.SQUARE[node]],
+                is_overloaded=overloaded,
+                node_label=100 + int(node),
+                area="0",
+                node_metric_increment_val=inc,
+            )
+        )
+
+    def test_drain_lifecycle(self):
+        ls = square()
+        ps = prefix_state_with(("4", "0", PrefixEntry(prefix=PFX)))
+        host, device = self.pair()
+        steps = 0
+
+        def check():
+            nonlocal steps
+            steps += 1
+            h = host.build_route_db({"0": ls}, ps)
+            d = device.build_route_db({"0": ls}, ps)
+            assert h.unicast_routes == d.unicast_routes, steps
+            assert h.mpls_routes == d.mpls_routes, steps
+            return h
+
+        # 1: baseline square — ECMP to 4 via both arms at cost 20
+        db = check()
+        assert nh_names(db.unicast_routes[PFX]) == {"2", "3"}
+
+        # 2: soft-drain 2 (+100): the 2->4 hop costs 110, so the via-2
+        # path loses (120 > 20) — traffic steers to 3, but 2 stays a
+        # legal transit (no cutoff)
+        self.readvertise(ls, "2", inc=100)
+        db = check()
+        assert nh_names(db.unicast_routes[PFX]) == {"3"}
+
+        # 3: soft-drain 3 too (+100): the drain is RELATIVE — with both
+        # arms equally inflated (120 each) ECMP returns at the higher
+        # cost, where a hard drain of both would have black-holed
+        self.readvertise(ls, "3", inc=100)
+        db = check()
+        assert nh_names(db.unicast_routes[PFX]) == {"2", "3"}
+        assert {nh.metric for nh in db.unicast_routes[PFX].nexthops} == {120}
+
+        # 4: undrain 3, hard-drain 2 — transit cutoff beats any metric:
+        # only the via-3 arm survives
+        self.readvertise(ls, "3")
+        self.readvertise(ls, "2", overloaded=True)
+        db = check()
+        assert nh_names(db.unicast_routes[PFX]) == {"3"}
+
+        # 5: hard-drain 3 as well — no transit-legal path to 4 remains;
+        # the route (and 4's node-label route) disappear instead of
+        # pointing through a drained node
+        self.readvertise(ls, "3", overloaded=True)
+        db = check()
+        assert PFX not in db.unicast_routes
+        assert 104 not in db.mpls_routes
+
+        # 6: full recovery on the same solver pair
+        self.readvertise(ls, "2")
+        self.readvertise(ls, "3")
+        db = check()
+        assert nh_names(db.unicast_routes[PFX]) == {"2", "3"}
+        assert {nh.metric for nh in db.unicast_routes[PFX].nexthops} == {20}
+
+        # 7: soft-draining YOURSELF shifts every egress equally — the
+        # selection is unchanged, only the advertised cost rises
+        self.readvertise(ls, "1", inc=50)
+        db = check()
+        assert nh_names(db.unicast_routes[PFX]) == {"2", "3"}
+        assert steps == 7
+
+    def test_soft_drain_is_proportional(self):
+        """Unlike the overload bit, the increment competes on cost: an
+        increment smaller than the alternative-path slack leaves the
+        drained node carrying traffic."""
+        ls = build_link_state(
+            {
+                "1": [adj("1", "2"), adj("1", "3", metric=50)],
+                "2": [adj("2", "1"), adj("2", "4")],
+                "3": [adj("3", "1", metric=50), adj("3", "4", metric=50)],
+                "4": [adj("4", "2"), adj("4", "3", metric=50)],
+            }
+        )
+        ps = prefix_state_with(("4", "0", PrefixEntry(prefix=PFX)))
+        host, device = self.pair()
+
+        def check():
+            h = host.build_route_db({"0": ls}, ps)
+            d = device.build_route_db({"0": ls}, ps)
+            assert h.unicast_routes == d.unicast_routes
+            assert h.mpls_routes == d.mpls_routes
+            return h
+
+        def drain2(inc):
+            ls.update_adjacency_database(
+                AdjacencyDatabase(
+                    this_node_name="2",
+                    adjacencies=[adj("2", "1"), adj("2", "4")],
+                    area="0",
+                    node_metric_increment_val=inc,
+                )
+            )
+
+        # via 2: 20; via 3: 100
+        db = check()
+        assert nh_names(db.unicast_routes[PFX]) == {"2"}
+
+        # +10 is within the 80-cost slack: 2 keeps the traffic at 30
+        drain2(10)
+        db = check()
+        assert nh_names(db.unicast_routes[PFX]) == {"2"}
+        assert {nh.metric for nh in db.unicast_routes[PFX].nexthops} == {30}
+
+        # +100 exceeds the slack (120 > 100): traffic finally moves
+        drain2(100)
+        db = check()
+        assert nh_names(db.unicast_routes[PFX]) == {"3"}
+
+    def test_soft_drained_node_stays_a_destination(self):
+        """Soft drain never isolates: a prefix advertised BY the drained
+        node keeps its route (at inflated cost), where a hard drain of
+        an intermediate hop can orphan it."""
+        ls = square()
+        ps = prefix_state_with(("2", "0", PrefixEntry(prefix=PFX)))
+        self.readvertise(ls, "2", inc=100)
+        db = routes("1", {"0": ls}, ps)
+        assert nh_names(db.unicast_routes[PFX]) == {"2"}
+
+
 class TestMplsLabelSemanticsPersistentPair:
     """Ancestors: SimpleRingTopologyFixture.IpToMplsLabelPrepend
     (DecisionTest.cpp:2228) + the node-label pop cases around

@@ -1,0 +1,221 @@
+"""Deterministic schedule exploration (openr_tpu.analysis.sched): DPOR
+reduction certificates, bit-identical replay, shrinking, the planted
+ordering bug, zero-overhead-off arming, and the auto-collected
+sched_corpus regression replays (the concurrency analogue of
+tests/chaos_corpus/).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import glob
+import json
+import os
+import threading
+from types import SimpleNamespace
+
+import pytest
+
+from openr_tpu.analysis import sched
+
+pytestmark = pytest.mark.sched
+
+CORPUS_DIR = os.path.join(os.path.dirname(__file__), "sched_corpus")
+
+PLANTED_SCENARIO = "router_hedge_vs_death"
+
+
+def _corpus_entries() -> list:
+    return sorted(glob.glob(os.path.join(CORPUS_DIR, "*.json")))
+
+
+def _load(path: str) -> dict:
+    with open(path) as fh:
+        return json.load(fh)
+
+
+class TestScheduleIds:
+    def test_format_parse_round_trip(self):
+        sid = sched.format_schedule_id("queue_shed_vs_carry", 3, [0, 2, 1])
+        assert sid == "queue_shed_vs_carry:s3:0.2.1"
+        assert sched.parse_schedule_id(sid) == (
+            "queue_shed_vs_carry", False, 3, [0, 2, 1]
+        )
+        # empty choice string spells "-" so the id stays 3-field
+        sid = sched.format_schedule_id(PLANTED_SCENARIO, 0, [], plant=True)
+        assert sid == f"{PLANTED_SCENARIO}+plant:s0:-"
+        assert sched.parse_schedule_id(sid) == (PLANTED_SCENARIO, True, 0, [])
+
+    @pytest.mark.parametrize(
+        "bad",
+        ["", "no-colons", "queue_shed_vs_carry:s0", "queue_shed_vs_carry:sX:0",
+         "queue_shed_vs_carry:s0:1.x", "unknown_scenario:s0:-"],
+    )
+    def test_malformed_ids_raise_value_error(self, bad):
+        with pytest.raises(ValueError):
+            sched.parse_schedule_id(bad)
+
+
+class TestZeroOverheadUnarmed:
+    """The TSAN standard: disarmed, every seam costs one module-constant
+    read and the stdlib is untouched."""
+
+    def test_unarmed_state(self):
+        assert sched.SCHED is None
+        assert not sched.patches_installed()
+        # the monkeypatches are strictly scoped to _execute(): outside a
+        # run the stdlib methods are the originals, not our wrappers
+        assert (concurrent.futures.Future.result
+                is not sched._patched_result)
+        assert threading.Thread.start is not sched._patched_thread_start
+
+    def test_patches_scoped_to_a_run_and_removed_after(self):
+        before = concurrent.futures.Future.result
+        run = sched.run_schedule("queue_shed_vs_carry", [])
+        assert run.steps > 0
+        assert not sched.patches_installed()
+        assert concurrent.futures.Future.result is before
+        assert sched.SCHED is None
+
+
+class TestDporReduction:
+    @pytest.mark.parametrize("scenario", sched.EXHAUSTIVE_SCENARIOS)
+    def test_dpor_explores_fewer_schedules_than_naive(self, scenario):
+        d = sched.explore(scenario, seed=0, mode="dpor")
+        n = sched.explore(scenario, seed=0, mode="naive")
+        # both certificates: the frontier drained, nothing was shed
+        assert d.complete and n.complete, scenario
+        assert d.schedules < n.schedules, (d.schedules, n.schedules)
+        assert d.prunes > 0
+        # soundness of the reduction: DPOR may not find a failure naive
+        # exploration misses (both must be empty on the unplanted library)
+        assert not d.failures and not n.failures
+        print(
+            f"{scenario}: dpor={d.schedules} naive={n.schedules} "
+            f"prunes={d.prunes} "
+            f"(ratio {n.schedules / d.schedules:.1f}x fewer)"
+        )
+
+    def test_exploration_is_deterministic(self):
+        a = sched.explore("queue_shed_vs_carry", seed=0, mode="dpor")
+        b = sched.explore("queue_shed_vs_carry", seed=0, mode="dpor")
+        assert (a.schedules, a.prunes, a.coverage_tokens) == (
+            b.schedules, b.prunes, b.coverage_tokens
+        )
+
+
+class TestPlantedBug:
+    """End-to-end proof the checker works: exploration finds the planted
+    ordering bug, the find replays bit-identically, and shrinking
+    reduces it to a minimal schedule that still fails the same way."""
+
+    def test_explore_finds_replays_and_shrinks_the_plant(self):
+        r = sched.explore(PLANTED_SCENARIO, plant=True, seed=0, mode="dpor")
+        assert r.complete and r.failures, "planted bug not found"
+        found = r.failures[0]
+        assert any("ledger-lost-update" in f for f in found.failures)
+
+        # bit-identical replay: same id -> same trace fingerprint twice
+        r1 = sched.replay_schedule(found.schedule_id)
+        r2 = sched.replay_schedule(found.schedule_id)
+        assert r1.trace == r2.trace
+        assert r1.trace_fingerprint() == found.trace_fingerprint
+        assert r1.failures == found.failures
+
+        # shrink preserves the failure signature and actually reduces
+        shrunk, best = sched.shrink_schedule(
+            PLANTED_SCENARIO, found.choices, plant=True
+        )
+        assert len(shrunk) <= 2 < len(found.choices)
+        assert sched._failure_signature(best.failures) == (
+            sched._failure_signature(found.failures)
+        )
+
+    def test_unplanted_scenario_is_clean_everywhere(self):
+        r = sched.explore(PLANTED_SCENARIO, plant=False, seed=0, mode="dpor")
+        assert r.complete and not r.failures
+
+
+class TestSchedCorpus:
+    def test_corpus_directory_is_nonempty(self):
+        assert _corpus_entries(), (
+            f"no corpus entries under {CORPUS_DIR} — the planted find's "
+            "minimal schedule must stay checked in"
+        )
+
+    @pytest.mark.parametrize(
+        "path", _corpus_entries(),
+        ids=[os.path.basename(p) for p in _corpus_entries()],
+    )
+    def test_corpus_entry_still_fails_its_oracle(self, path):
+        entry = _load(path)
+        scenario, plant, _seed, choices = sched.parse_schedule_id(
+            entry["schedule_id"]
+        )
+        # minimality contract: shrunk entries only
+        assert len(choices) <= 4, entry["schedule_id"]
+        run = sched.replay_schedule(entry["schedule_id"])
+        assert entry["oracle"] in sched._failure_signature(run.failures), (
+            entry["schedule_id"], run.failures
+        )
+        if plant:
+            # the regression pins the INTERLEAVING: without the planted
+            # window the same choices replay clean
+            clean = sched.run_schedule(scenario, choices, plant=False)
+            assert not clean.failures, clean.failures
+
+
+class TestTier1Smoke:
+    def test_library_sweep_is_clean_with_certificates(self):
+        out = sched.tier1_smoke(total_budget_s=60.0)
+        assert out["failures"] == []
+        assert out["shed"] == [], "healthy box shed scenarios (raise budget)"
+        assert set(out["scenarios"]) == set(sched.SCENARIOS)
+        for name in sched.EXHAUSTIVE_SCENARIOS:
+            row = out["scenarios"][name]
+            assert row["mode"] == "dpor" and row["complete"], (name, row)
+
+    def test_budget_sheds_loudly_never_silently(self):
+        out = sched.tier1_smoke(total_budget_s=1e-4)
+        covered = set(out["scenarios"]) | set(out["shed"])
+        assert covered == set(sched.SCENARIOS)
+        assert out["shed"], "sub-ms budget must shed at least one scenario"
+
+
+class TestFuzzFrontierTokens:
+    def test_sample_tokens_shape_and_determinism(self):
+        a = sched.sample_tokens(7, n_schedules=8)
+        b = sched.sample_tokens(7, n_schedules=8)
+        assert a and a == b
+        for tok in a:
+            kind, scenario, fp = tok.split(":")
+            assert kind == "sched" and scenario in sched.SCENARIOS
+            assert len(fp) == 10 and int(fp, 16) >= 0
+
+
+class TestCliContract:
+    """Exit codes match the analyzer convention: 0 clean, 1 findings,
+    2 infra/misuse."""
+
+    @staticmethod
+    def ns(**kw):
+        base = dict(sched_replay=None, sched_shrink=None, sched_seed=0)
+        base.update(kw)
+        return SimpleNamespace(**base)
+
+    def test_replay_exit_codes(self, capsys):
+        planted = f"{PLANTED_SCENARIO}+plant:s0:1.1"
+        assert sched.run_cli(self.ns(sched_replay=planted)) == 1
+        assert "ledger-lost-update" in capsys.readouterr().out
+        clean = f"{PLANTED_SCENARIO}:s0:1.1"
+        assert sched.run_cli(self.ns(sched_replay=clean)) == 0
+
+    def test_malformed_id_is_infra_not_finding(self, capsys):
+        assert sched.run_cli(self.ns(sched_replay="bogus:s0:-")) == 2
+        assert "infra error" in capsys.readouterr().out
+
+    def test_shrink_mode_prints_minimal_id(self, capsys):
+        planted = f"{PLANTED_SCENARIO}+plant:s0:0.0.1.1.0.0"
+        assert sched.run_cli(self.ns(sched_shrink=planted)) == 1
+        out = capsys.readouterr().out
+        assert "shrunk 6 ->" in out and "FAIL ledger-lost-update" in out
