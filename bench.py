@@ -2593,6 +2593,203 @@ def bench_serving_fleet_wan100k(
     }
 
 
+def bench_fleet_scaleout_wan100k(
+    topo,
+    n: int = 100_000,
+    seed: int = 13,
+    clients: int = 6,
+    qps_per_client: float = 30.0,
+    duration_s: float = 2.0,
+) -> dict:
+    """Elastic scale-out economics (round-20 tentpole): what a joining
+    replica pays before it serves its first query, cold vs
+    snapshot-restored, plus the router's qps/p99 curve across live
+    scale(1 -> 2 -> 4) membership transitions.
+
+    Segment A builds the OCS chorded ring at wan scale, checkpoints the
+    donor engine (EngineSnapshot, serialized blob) and brings the SAME
+    fresh mirror up twice on fresh engines: once cold (the first served
+    query pays restage + XLA compile + query) and once restored (the
+    install rung + manifest prewarm run at bring-up, OFF the serving
+    path, so the first served query pays only the query).  The headline
+    is time-to-first-served-query: restore must beat cold, and the
+    restored replica's answers must match the donor's bit-exact.
+
+    Segment B reuses the serving-fleet open-loop harness but keeps ONE
+    router alive across the whole run and grows membership in place via
+    `add_replica` (the fleet join path): per-k qps/p50/p99 plus the
+    exactly-closing dispatch ledger over the union of all segments —
+    the join transition may not leak a single unaccounted dispatch.
+
+    Honors OPENR_BENCH_BUDGET_S: each segment sheds whole, and says so
+    in the row."""
+    from openr_tpu.chaos.ocs import OcsController
+    from openr_tpu.chaos.overload import OpenLoopLoadGen
+    from openr_tpu.decision.csr import CsrTopology
+    from openr_tpu.device.engine import DeviceResidencyEngine
+    from openr_tpu.serving import (
+        QueryScheduler,
+        ReplicaRouter,
+        SchedulerReplica,
+    )
+    from openr_tpu.serving.router import dispatch_ledger_closes
+    from openr_tpu.snapshot import SNAPSHOT_COUNTERS, EngineSnapshot
+
+    def view(result):
+        return {
+            k: (v.metric, frozenset(v.next_hops)) for k, v in result.items()
+        }
+
+    # -- segment A: cold vs snapshot-restored bring-up ----------------------
+    snapshot_section: dict
+    if _budget_left() < 300:
+        snapshot_section = _shed_marker("fleet_scaleout_wan100k:snapshot")
+    else:
+        ctl = OcsController(seed=seed, n=n, rounds=1, fault_round=-1)
+        ls = ctl._build_ls(ctl._initial_chords(), {})
+        names = ls.node_names
+        sources = [names[(seed * 977 + k * 40503) % n] for k in range(8)]
+
+        donor_csr = CsrTopology.from_link_state(ls)
+        donor = DeviceResidencyEngine()
+        donor.sync(donor_csr)
+        donor_view = {
+            s: view(r) for s, r in donor.spf_results(donor_csr, sources).items()
+        }  # compiles the serving ladder key the manifest will carry
+
+        c0 = SNAPSHOT_COUNTERS.get_counters()
+        t0 = time.perf_counter()
+        blob = EngineSnapshot.take(donor, donor_csr).to_bytes()
+        take_s = time.perf_counter() - t0
+
+        # ONE fresh mirror, brought up twice on fresh engines: identical
+        # starting state for both paths (cold runs first, so any global
+        # caching would help cold, not the restore being measured)
+        t0 = time.perf_counter()
+        joiner_csr = CsrTopology.from_link_state(ls)
+        mirror_build_s = time.perf_counter() - t0
+
+        cold = DeviceResidencyEngine()
+        t0 = time.perf_counter()
+        cold_res = cold.spf_results(joiner_csr, sources)
+        cold_first_query_s = time.perf_counter() - t0
+
+        warm = DeviceResidencyEngine()
+        t0 = time.perf_counter()
+        mode = EngineSnapshot.from_bytes(blob).restore(warm, joiner_csr)
+        bringup_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        warm_res = warm.spf_results(joiner_csr, sources)
+        warm_first_query_s = time.perf_counter() - t0
+
+        assert mode == "install", mode
+        parity = all(
+            view(warm_res[s]) == donor_view[s]
+            and view(cold_res[s]) == donor_view[s]
+            for s in sources
+        )
+        assert parity, "restored replica diverged from donor"
+        assert warm_first_query_s < cold_first_query_s, (
+            warm_first_query_s,
+            cold_first_query_s,
+        )
+        c1 = SNAPSHOT_COUNTERS.get_counters()
+        snapshot_section = {
+            "n_nodes": n,
+            "snapshot_bytes": len(blob),
+            "take_s": round(take_s, 3),
+            "mirror_build_s": round(mirror_build_s, 3),
+            "restore_mode": mode,
+            "restore_bringup_s": round(bringup_s, 3),
+            "manifest_programs": c1["snapshot.manifest_programs"]
+            - c0["snapshot.manifest_programs"],
+            "prewarmed_programs": c1["snapshot.prewarmed_programs"]
+            - c0["snapshot.prewarmed_programs"],
+            "cold_first_query_s": round(cold_first_query_s, 3),
+            "restored_first_query_s": round(warm_first_query_s, 3),
+            "first_query_speedup": round(
+                cold_first_query_s / max(warm_first_query_s, 1e-9), 1
+            ),
+            "restored_vs_donor_parity": parity,
+        }
+
+    # -- segment B: live 1 -> 2 -> 4 membership transitions -----------------
+    transitions: dict = {}
+    ledger = None
+    if _budget_left() < 3 * (3 * duration_s + 10):
+        transitions = _shed_marker("fleet_scaleout_wan100k:transitions")
+    else:
+        s_pad = 16
+        backend = _WanServingBackend(topo, s_pad)
+        backend.run_paths("0", list(range(s_pad)))  # warm the program
+        nodes = [int(s) for s in _wan_router_sources(topo)]
+        nodes += [int(x) for x in range(0, topo.n_nodes, topo.n_nodes // 64)]
+
+        scheds = [
+            QueryScheduler(backend, max_pending=8192, max_coalesce=s_pad)
+            for _ in range(4)
+        ]
+        scheds[0].run()
+        started = [scheds[0]]
+        router = ReplicaRouter(
+            [SchedulerReplica("rep-0", scheds[0])], hedge_after_s=None
+        )
+        total_submitted = 0
+        try:
+            for k in (1, 2, 4):
+                while len(started) < k:
+                    s = scheds[len(started)]
+                    s.run()
+                    router.add_replica(
+                        SchedulerReplica(f"rep-{len(started)}", s)
+                    )
+                    started.append(s)
+                if _budget_left() < 3 * duration_s + 10:
+                    transitions[str(k)] = None  # shed whole
+                    continue
+                gen = OpenLoopLoadGen(
+                    router, nodes=nodes, seed=7, clients=clients, sessions=True
+                )
+                report = gen.run_paced(
+                    duration_s, qps_per_client, gather_timeout_s=300.0
+                )
+                total_submitted += report.submitted
+                transitions[str(k)] = {
+                    "submitted": report.submitted,
+                    "sustained_qps": round(report.qps, 1),
+                    "p50_us": report.pctl_us(50),
+                    "p99_us": report.pctl_us(99),
+                    "shed": report.shed,
+                    "errors": report.errors,
+                    "zero_silent_drops": report.accounted == report.submitted,
+                }
+            counters = router.get_counters()
+        finally:
+            router.stop()
+            for s in started:
+                s.stop()
+        # ONE ledger over the union of segments: the two join
+        # transitions happened under this router and must not have
+        # leaked a single unaccounted dispatch
+        ledger = {
+            "submitted": total_submitted,
+            "dispatches": counters["serving.router.dispatches"],
+            "closes_exactly": dispatch_ledger_closes(
+                counters, total_submitted
+            ),
+        }
+        assert ledger["closes_exactly"], (counters, total_submitted)
+
+    return {
+        "snapshot_bringup": snapshot_section,
+        "clients": clients,
+        "offered_qps": round(clients * qps_per_client, 1),
+        "duration_s": duration_s,
+        "scale_transitions": transitions,
+        "dispatch_ledger": ledger,
+    }
+
+
 def bench_te_wan100k(
     topo,
     n_sources: int = 512,
@@ -2760,6 +2957,11 @@ DEVICE_ROWS = {
     # the ReplicaRouter, plus a mid-run replica-kill segment (p99 delta,
     # zero-silent-drops ledger, failover counters)
     "serving_fleet_wan100k": lambda t: bench_serving_fleet_wan100k(t.wan),
+    # round-20 elastic scale-out: cold vs snapshot-restored replica
+    # bring-up (time-to-first-served-query, restored-vs-donor parity)
+    # plus live 1->2->4 add_replica transitions under open-loop load
+    # with the union dispatch ledger closing exactly
+    "fleet_scaleout_wan100k": lambda t: bench_fleet_scaleout_wan100k(t.wan),
     # differentiable TE: gradient-descent metric optimization with the
     # exact-solver acceptance gate vs host hill-climb at equal exact
     # evaluations (openr_tpu/te; docs/OPERATIONS.md "TE runbook")

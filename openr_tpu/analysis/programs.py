@@ -703,6 +703,49 @@ def _drive_te(state: dict) -> None:
     )
 
 
+def _drive_snapshot(state: dict) -> None:
+    """Engine-snapshot restore rungs over the banded ring: take a
+    checkpoint, drift the donor mirror (replay rung: the engine's
+    incremental ladder runs under restore), then install the serialized
+    artifact into a fresh engine over a content-identical fresh mirror
+    (install rung + manifest prewarm — the AOT lowering path records
+    its specs with no example arrays), and finally demote against a
+    drifted foreign mirror (cold rung: the ordinary restage).  The
+    asserts keep the driver honest about which rung each step took."""
+    from ..decision.csr import CsrTopology
+    from ..device.engine import DeviceResidencyEngine
+    from ..snapshot import EngineSnapshot
+
+    ls = _ring_link_state()
+    csr = CsrTopology.from_link_state(ls)
+    donor = DeviceResidencyEngine()
+    donor.spf_results(csr, ["r000"])  # compile the manifest's ladder key
+    snap = EngineSnapshot.take(donor, csr)
+    blob = snap.to_bytes()
+    # donor drift -> replay rung (masked-write incremental under restore)
+    _update_ring_node(ls, 9, metric_fn=lambda i, j: 31)
+    assert csr.refresh(ls), "attribute flap must stay in place"
+    assert snap.restore(donor, csr) == "replay"
+    donor.spf_results(csr, ["r001"])
+    # fresh replica, content-identical mirror -> install rung + prewarm
+    fresh_ls = _ring_link_state()
+    _update_ring_node(fresh_ls, 9, metric_fn=lambda i, j: 31)
+    fresh_csr = CsrTopology.from_link_state(fresh_ls)
+    joiner = DeviceResidencyEngine()
+    warm = EngineSnapshot.take(donor, csr)
+    assert warm.restore(joiner, fresh_csr) == "install"
+    joiner.spf_results(fresh_csr, ["r002"])
+    # stale serialized artifact vs a drifted foreign mirror -> cold rung
+    drifted_ls = _ring_link_state()
+    _update_ring_node(drifted_ls, 3, metric_fn=lambda i, j: 29)
+    drifted_csr = CsrTopology.from_link_state(drifted_ls)
+    cold_eng = DeviceResidencyEngine()
+    assert EngineSnapshot.from_bytes(blob).restore(cold_eng, drifted_csr) == (
+        "cold"
+    )
+    cold_eng.spf_results(drifted_csr, ["r003"])
+
+
 DRIVERS: tuple[tuple[str, Callable[[dict], None]], ...] = (
     ("engine", _drive_engine),
     ("rewire", _drive_rewire),
@@ -716,6 +759,7 @@ DRIVERS: tuple[tuple[str, Callable[[dict], None]], ...] = (
     ("protection", _drive_protection),
     ("forward_direct", _drive_forward_direct),
     ("te", _drive_te),
+    ("snapshot", _drive_snapshot),
 )
 
 

@@ -59,8 +59,12 @@ from typing import Optional
 from .chaos import SCENARIO_STREAM, ChaosEventLog, KvChaosInjector, wait_until
 from .scenario import ChaosScenario
 
-CORPUS_VERSION = 1
-FAMILIES = ("ocs", "flap", "kv", "fleet", "engine")
+# v1 -> v2: the `snapshot` event family (engine snapshot take/restore +
+# elastic fleet scale/kill) joined the generator; v1 entries replay
+# unchanged semantically but are re-stamped so an old harness can never
+# silently drop the new family's events
+CORPUS_VERSION = 2
+FAMILIES = ("ocs", "flap", "kv", "fleet", "engine", "snapshot")
 
 FUZZ_COUNTER_KEYS = (
     "chaos.fuzz.runs",
@@ -123,6 +127,18 @@ _FP_DELTA_KEYS = (
     "decision.delta.updates",
     "decision.delta.noop_updates",
     "decision.delta.fallbacks",
+)
+# snapshot family: deterministic-per-timeline counters only.  EXCLUDED:
+# *_us timers, snapshot.bytes (capacity padding detail), and
+# manifest_programs / prewarmed_programs (cross-run program-cache state
+# on the shared engine)
+_FP_SNAPSHOT_KEYS = (
+    "snapshot.taken",
+    "snapshot.restores",
+    "snapshot.replayed_events",
+    "snapshot.replay_fallbacks",
+    "snapshot.scaleouts",
+    "snapshot.scaleins",
 )
 
 
@@ -329,9 +345,21 @@ class _FuzzWorld:
         self.blocked_failures = 0
         self.tokens: set = set()
 
+        # snapshot satellite: the last taken engine snapshot plus the
+        # scripted facts its oracles need (accounted cold demotions feed
+        # the restage budget; roundtrip failures are an oracle of their
+        # own)
+        self.snap = None
+        self.snapshot_demotes = 0
+        self.snapshot_failures = 0
+
         # counter baselines (shared engine: everything is diffed)
         self._eng0 = self.engine.get_counters()
         self._blk0 = self.engine.blocked.get_counters()
+        from ..snapshot import SNAPSHOT_COUNTERS as _snapc
+
+        self._snapc = _snapc
+        self._snap0 = _snapc.get_counters()
 
         # OPENR_TRACE: drain span-structure tokens accumulated by any
         # EARLIER run so this timeline's fingerprint only sees its own
@@ -879,6 +907,139 @@ class _FuzzWorld:
         )
         self.tokens.add("engine:blocked")
 
+    # -- event appliers: snapshot ---------------------------------------------
+    #
+    # Engine snapshots over the world's own (engine, csr) pair plus
+    # elastic membership on the fleet satellite.  Scripted step labels
+    # carry only timeline-deterministic facts: the restore rung is one
+    # (same world state -> same rung), but blob length and manifest size
+    # depend on cross-run program-cache state and stay out of the log.
+
+    def _ev_snapshot_take(self, p: dict) -> None:
+        from ..snapshot import EngineSnapshot
+
+        snap = self._retry_injected(
+            lambda: EngineSnapshot.take(self.engine, self.csr)
+        )
+        blob = snap.to_bytes()
+        # the wire format must roundtrip byte-identically through its
+        # digest check; a planted corruption is caught by from_bytes
+        try:
+            if EngineSnapshot.from_bytes(blob).to_bytes() != blob:
+                self.snapshot_failures += 1
+        except Exception:  # noqa: BLE001 — any raise is the violation
+            self.snapshot_failures += 1
+        self.snap = snap
+        self.scenario.step("fuzz:snapshot:take")
+        self.tokens.add("snapshot:take")
+
+    def _ev_snapshot_restore(self, p: dict) -> None:
+        if self.snap is None:
+            self.scenario.step("fuzz:snapshot:restore:noop")
+            return
+        eng0 = self.engine.get_counters()
+        mode = self._retry_injected(
+            lambda: self.snap.restore(self.engine, self.csr)
+        )
+        eng1 = self.engine.get_counters()
+        # a cold demotion restages once; a rewire fallback inside the
+        # replay sync is already budgeted by the rewire_falls term
+        d_restage = (
+            eng1["device.engine.full_restages"]
+            - eng0["device.engine.full_restages"]
+        )
+        d_falls = (
+            eng1["device.engine.rewire_fallbacks"]
+            - eng0["device.engine.rewire_fallbacks"]
+        )
+        self.snapshot_demotes += max(0, d_restage - d_falls)
+        self.scenario.step(f"fuzz:snapshot:restore:{mode}")
+        self.tokens.add(f"snapshot:restore:{mode}")
+
+    def _ev_snapshot_scale(self, p: dict) -> None:
+        self._ensure_fleet()
+        from ..decision.spf_solver import DeviceSpfBackend
+        from ..serving import EngineBatchBackend, QueryScheduler
+        from ..snapshot import EngineSnapshot
+        from .replicafleet import ChaosReplicaHandle
+
+        f = self.fleet
+        handles = f["handles"]
+        # bound the satellite: at most two joiners per run (a fuzzer
+        # that minted a replica per event would own the wall clock)
+        if len(handles) >= 4:
+            self.scenario.step("fuzz:snapshot:scale:noop")
+            return
+        i = len(handles)
+        from ..decision.link_state import LinkState
+
+        ls = LinkState("0")
+        for node in range(_FLEET_N):
+            ls.update_adjacency_database(self._fleet_db(node, {}))
+        backend = EngineBatchBackend(
+            {"0": ls}, spf_backend=DeviceSpfBackend(engine=self.engine)
+        )
+        sched = QueryScheduler(backend)
+        sched.run()
+        handle = ChaosReplicaHandle(f"fz-replica-{i}", sched, ls)
+        self._fleet_catch_up(handle)
+        donor = handles[0]
+        mode = "skipped"
+        try:
+            d_spf = donor.scheduler.backend.spf
+            snap = self._retry_injected(
+                lambda: EngineSnapshot.take(
+                    self.engine, d_spf.csr_mirror(donor.ls)
+                )
+            )
+            eng0 = self.engine.get_counters()
+            mode = self._retry_injected(
+                lambda: snap.restore(
+                    self.engine, backend.spf.csr_mirror(ls)
+                )
+            )
+            eng1 = self.engine.get_counters()
+            d_restage = (
+                eng1["device.engine.full_restages"]
+                - eng0["device.engine.full_restages"]
+            )
+            d_falls = (
+                eng1["device.engine.rewire_fallbacks"]
+                - eng0["device.engine.rewire_fallbacks"]
+            )
+            self.snapshot_demotes += max(0, d_restage - d_falls)
+        except Exception:  # noqa: BLE001 — warm start is best-effort
+            mode = "skipped"
+        handles.append(handle)
+        f["router"].add_replica(handle)
+        self._snapc._bump("snapshot.scaleouts")
+        self.scenario.step(f"fuzz:snapshot:scale:{handle.name}:{mode}")
+        self.tokens.add("snapshot:scale")
+
+    def _ev_snapshot_kill(self, p: dict) -> None:
+        f = self.fleet
+        joined = (
+            []
+            if f is None
+            else [
+                h
+                for h in f["handles"]
+                if not h.killed and h.name >= "fz-replica-2"
+            ]
+        )
+        if not joined:
+            self.scenario.step("fuzz:snapshot:kill:noop")
+            return
+        handle = joined[-1]
+        # leave the handle in the list (killed): the restage budget
+        # counts replicas ever minted, and settle skips dead schedulers
+        f["router"].remove_replica(handle.name)
+        handle.killed = True
+        handle.scheduler.stop()
+        self._snapc._bump("snapshot.scaleins")
+        self.scenario.step(f"fuzz:snapshot:kill:{handle.name}")
+        self.tokens.add("snapshot:kill")
+
     # -- run ------------------------------------------------------------------
 
     def apply(self, ev: FuzzEvent) -> bool:
@@ -925,6 +1086,11 @@ class _FuzzWorld:
 
         if self.blocked_failures:
             failures.append("blocked_ok")
+
+        # snapshot: the wire format must have roundtripped through its
+        # digest check every time a take event fired
+        if self.snapshot_failures:
+            failures.append("snapshot_roundtrip")
 
         # kv: heal, then every storm key must expire from every store
         # and the harness ledger must account every planted key
@@ -981,7 +1147,14 @@ class _FuzzWorld:
             eng["device.engine.rewire_fallbacks"]
             - self._eng0["device.engine.rewire_fallbacks"]
         )
-        budget = 1 + self.delta_registered + self.rebuilds + rewire_falls
+        budget = (
+            1
+            + self.delta_registered
+            + self.rebuilds
+            + rewire_falls
+            # every accounted snapshot demotion is a scripted cold build
+            + self.snapshot_demotes
+        )
         # the cache's internal CSR mirror restages independently of the
         # engine-query mirror: one more allowed first contact per run
         if self.view_modes:
@@ -1027,6 +1200,11 @@ class _FuzzWorld:
             d = self.local.get(key, 0)
             if d > 0:
                 tokens.add(f"{key}:{d.bit_length()}")
+        snapc = self._snapc.get_counters()
+        for key in _FP_SNAPSHOT_KEYS:
+            d = snapc.get(key, 0) - self._snap0.get(key, 0)
+            if d > 0:
+                tokens.add(f"{key}:{d.bit_length()}")
         for op in self.fired:
             tokens.add(f"fault:{op}")
         # span-tree structure as a novelty signal: a new retry/hedge edge
@@ -1051,6 +1229,13 @@ class _FuzzWorld:
             {k: blk.get(k, 0) - self._blk0.get(k, 0) for k in _FP_BLOCKED_KEYS}
         )
         out.update({k: self.local.get(k, 0) for k in _FP_DELTA_KEYS})
+        snapc = self._snapc.get_counters()
+        out.update(
+            {
+                k: snapc.get(k, 0) - self._snap0.get(k, 0)
+                for k in _FP_SNAPSHOT_KEYS
+            }
+        )
         return out
 
     def close(self) -> None:
@@ -1166,6 +1351,15 @@ def _rand_event(rng: random.Random, family: str) -> FuzzEvent:
                 "fleet", "flap", {"node": rng.randrange(_FLEET_N)}
             )
         return FuzzEvent("fleet", kind, {"idx": rng.randrange(2)})
+    if family == "snapshot":
+        # take/restore on the world mirror; scale/kill on the fleet
+        # satellite.  All kinds are tolerant no-ops when their target
+        # state is absent (restore before take, kill before scale), so
+        # shrinking can delete any prefix
+        kind = rng.choice(
+            ("take", "restore", "restore", "scale", "kill")
+        )
+        return FuzzEvent("snapshot", kind, {})
     # engine
     kind = rng.choice(("arm", "spf", "spf", "pallas_mode", "blocked"))
     if kind == "arm":

@@ -133,6 +133,8 @@ class ReplicaFleetController:
         partition_round: int = 5,
         heal_round: int = 6,
         lag_rounds: tuple = (3, 6),
+        scaleout_round: Optional[int] = None,
+        scalein_round: Optional[int] = None,
         hedge_after_s: Optional[float] = 0.02,
         spf_backend=None,
         log_: Optional[ChaosEventLog] = None,
@@ -148,6 +150,12 @@ class ReplicaFleetController:
         self.partition_round = partition_round
         self.heal_round = heal_round
         self.lag_rounds = tuple(lag_rounds)
+        # elastic membership schedule (None keeps legacy timelines
+        # byte-identical): scale-out joins a snapshot-warm-started
+        # replica mid-burst; scale-in removes and kills the youngest
+        # JOINED replica (never a scripted fault target)
+        self.scaleout_round = scaleout_round
+        self.scalein_round = scalein_round
         self.hedge_after_s = hedge_after_s
         self.spf_backend = spf_backend
         self.log = log_ if log_ is not None else ChaosEventLog()
@@ -157,6 +165,8 @@ class ReplicaFleetController:
         self.kill_idx = 1 % self.replicas
         self.partition_idx = (self.replicas - 1) % self.replicas
         self.lag_idx = 0
+        self._minted = self.replicas  # next replica name index
+        self._joined: list[ChaosReplicaHandle] = []
 
     # -- topology --------------------------------------------------------------
 
@@ -224,6 +234,69 @@ class ReplicaFleetController:
         handle.scheduler.run()
         self._catch_up(handle, updates)
         handle.killed = False
+
+    def _scale_out_prepare(self, handles: list, updates: list):
+        """Build and warm-start the joining replica while the fleet is
+        quiescent (between bursts): built like the initial fleet, caught
+        up on the update stream, snapshot-warm-started from replica 0's
+        device engine (install or accounted cold — see openr_tpu/
+        snapshot).  Quiescence is load-bearing for the replay contract:
+        the donor engine is not mid-dispatch, so the restore mode is a
+        pure function of the seed's update stream (same stream -> same
+        mirror content -> same rung).  Returns (handle, mode); the
+        router join itself happens mid-burst in _scale_out_join."""
+        i = self._minted
+        self._minted += 1
+        ls = self._build_ls()
+        backend = EngineBatchBackend({"0": ls}, spf_backend=self.spf_backend)
+        sched = QueryScheduler(backend)
+        sched.run()
+        handle = ChaosReplicaHandle(f"replica-{i}", sched, ls)
+        self._catch_up(handle, updates)
+        mode = "skipped"
+        donor = handles[0].scheduler.backend
+        d_spf = getattr(donor, "spf", None)
+        j_spf = backend.spf
+        # a shared spf_backend means one engine and one mirror cache —
+        # nothing to warm-start across
+        if (
+            hasattr(d_spf, "csr_mirror")
+            and hasattr(j_spf, "csr_mirror")
+            and d_spf is not j_spf
+        ):
+            try:
+                from ..snapshot import EngineSnapshot
+
+                snap = EngineSnapshot.take(
+                    d_spf.engine, d_spf.csr_mirror(handles[0].ls)
+                )
+                mode = snap.restore(j_spf.engine, j_spf.csr_mirror(ls))
+            except Exception:  # noqa: BLE001 — warm start is best-effort
+                mode = "skipped"
+        return handle, mode
+
+    def _scale_out_join(self, handles: list, router, handle) -> None:
+        """Add the prepared replica to the live router mid-burst: the
+        membership swap and the dispatch-ledger extension are what the
+        join exercises under load."""
+        handles.append(handle)
+        self._joined.append(handle)
+        router.add_replica(handle)
+
+    def _scale_in(self, handles: list, router) -> Optional[str]:
+        """Remove and kill the youngest joined replica under load: the
+        router stops picking it immediately and folds its final counters,
+        then its scheduler dies loudly (in-flight work sheds and
+        re-routes).  Scripted fault targets are never scale-in victims,
+        so the kill/partition/lag schedule stays index-stable."""
+        if not self._joined:
+            return None
+        handle = self._joined.pop()
+        handles.remove(handle)
+        router.remove_replica(handle.name)
+        handle.killed = True
+        handle.scheduler.stop()
+        return handle.name
 
     # -- run ---------------------------------------------------------------------
 
@@ -378,6 +451,9 @@ class ReplicaFleetController:
             if r == self.partition_round:
                 sc.step(f"fleet:partition:replica-{self.partition_idx}:{r}")
                 handles[self.partition_idx].partitioned = True
+            if r == self.scalein_round:
+                gone = self._scale_in(handles, router)
+                sc.step(f"fleet:scalein:{gone or 'noop'}:{r}")
 
             # one topology flap per round: exactly one epoch bump, so
             # every epoch the fleet can answer at has an oracle snapshot
@@ -417,6 +493,24 @@ class ReplicaFleetController:
                     )
 
                 run_burst(r, concurrent_fault=kill_mid_burst)
+            elif r == self.scaleout_round:
+                # warm-start on a quiescent fleet: the donor engine is
+                # not mid-dispatch, so the restore mode is a pure
+                # function of the seed's update stream — which makes it
+                # part of the replay contract
+                joiner, mode = self._scale_out_prepare(handles, updates)
+                sc.step(f"fleet:scaleout:{r}:{mode}")
+
+                def scaleout_mid_burst(r=r, joiner=joiner) -> None:
+                    # let the burst saturate the old fleet first, so the
+                    # router join really happens under load
+                    time.sleep(0.05)
+                    sc.step(
+                        f"fleet:scaleout:join:{r}",
+                        lambda: self._scale_out_join(handles, router, joiner),
+                    )
+
+                run_burst(r, concurrent_fault=scaleout_mid_burst)
             else:
                 run_burst(r)
 

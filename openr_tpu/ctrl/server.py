@@ -57,6 +57,7 @@ class OpenrCtrlHandler:
         fuzz=None,
         sched=None,
         obs=None,
+        snapshot=None,
         config=None,
         kvstore_updates_queue: Optional[ReplicateQueue[Publication]] = None,
         fib_updates_queue: Optional[ReplicateQueue] = None,
@@ -98,6 +99,9 @@ class OpenrCtrlHandler:
         # trace counters (zeroed when unarmed) plus the dumpTraces /
         # getSpanSamples methods below
         self.obs = obs
+        # engine-snapshot registry (openr_tpu.snapshot.SNAPSHOT_COUNTERS):
+        # exports snapshot.* (pre-seeded zeros) the same way
+        self.snapshot = snapshot
         self.config = config
         self.kvstore_updates_queue = kvstore_updates_queue
         self.fib_updates_queue = fib_updates_queue
@@ -432,6 +436,7 @@ class OpenrCtrlHandler:
             self.fuzz,
             self.sched,
             self.obs,
+            self.snapshot,
         ):
             if module is None:
                 continue

@@ -579,6 +579,125 @@ class DeviceResidencyEngine:
         """Forget `csr`'s residency (mirror retired)."""
         self._residents.pop(id(csr), None)
 
+    # -- snapshot seams (openr_tpu/snapshot) --------------------------------
+
+    def export_resident(self, csr) -> dict:
+        """Host-side image of `csr`'s residency for EngineSnapshot.take:
+        sync first (the checkpoint is always at the mirror's current
+        version), then one batched explicit device_get per surface —
+        the snapshot layer never touches _Resident internals."""
+        res = self.sync(csr)
+        names = (
+            "edge_src",
+            "edge_dst",
+            "edge_metric",
+            "edge_up",
+            "node_overloaded",
+            "out_slot",
+        )
+        fetched = jax.device_get(tuple(getattr(res, n) for n in names))
+        leaves = jax.device_get(jax.tree_util.tree_leaves(res.ell))
+        return {
+            "topo_key": res.topo_key,
+            "version": res.version,
+            "rewire_seq": res.rewire_seq,
+            "sweep_hint": res.sweep_hint,
+            "arrays": {
+                n: np.asarray(a) for n, a in zip(names, fetched)
+            },
+            "ell_leaves": [np.asarray(x) for x in leaves],
+        }
+
+    def install_resident(
+        self,
+        csr,
+        state: dict,
+        *,
+        version: Optional[int] = None,
+        rewire_seq: Optional[int] = None,
+    ) -> _Resident:
+        """Install a host-side resident image (export_resident shape) as
+        `csr`'s device residency.  The shadows come from the image, so a
+        following sync() reconciles any attribute drift between the
+        checkpoint and `csr` through the ordinary incremental rung.
+        `version`/`rewire_seq` override the image's position when the
+        caller proved `csr`'s content already matches (the snapshot
+        content-equality rung)."""
+        arr = state["arrays"]
+        leaves = [np.asarray(x) for x in state["ell_leaves"]]
+        treedef = jax.tree_util.tree_structure(csr.ell)
+        ell = jax.tree_util.tree_unflatten(
+            treedef, [jax.device_put(x) for x in leaves]
+        )
+        staged = _nbytes(*arr.values()) + _nbytes(*leaves)
+        res = _Resident(
+            topo_key=tuple(state["topo_key"]),
+            ell_host=csr.ell,
+            version=int(
+                state["version"] if version is None else version
+            ),
+            ell=ell,
+            edge_src=jax.device_put(arr["edge_src"]),
+            edge_dst=jax.device_put(arr["edge_dst"]),
+            edge_metric=jax.device_put(arr["edge_metric"]),
+            edge_up=jax.device_put(arr["edge_up"]),
+            node_overloaded=jax.device_put(arr["node_overloaded"]),
+            out_slot=jax.device_put(arr["out_slot"]),
+            shadow_metric=np.asarray(arr["edge_metric"]).copy(),
+            shadow_up=np.asarray(arr["edge_up"]).copy(),
+            shadow_overloaded=np.asarray(arr["node_overloaded"]).copy(),
+            sweep_hint=int(state.get("sweep_hint", 16)),
+            rewire_seq=int(
+                state["rewire_seq"] if rewire_seq is None else rewire_seq
+            ),
+        )
+        self._residents[id(csr)] = res
+        self._bump("device.engine.bytes_staged", staged)
+        return res
+
+    def prewarm(self, csr, keys) -> int:
+        """AOT-compile manifest ladder keys against `csr`'s resident
+        shapes (snapshot warm-start).  Lowering takes ShapeDtypeStructs,
+        so no example arrays are materialized — the XLA compile is the
+        cold-start cost being moved off the serving path.  Keys for a
+        different topology, or already cached, are skipped.  Returns how
+        many programs were actually compiled."""
+        res = self._residents.get(id(csr))
+        if res is None or res.ell_host is not csr.ell:
+            return 0
+
+        def sds(x):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+        warmed = 0
+        for key in keys:
+            topo, s_bucket, n_words, n_sweeps, small, use_lm = key
+            key = (
+                tuple(topo),
+                int(s_bucket),
+                int(n_words),
+                int(n_sweeps),
+                bool(small),
+                bool(use_lm),
+            )
+            if key in self._programs or key[0] != res.topo_key:
+                continue
+            n_cap = res.topo_key[0]
+            args = (
+                jax.ShapeDtypeStruct((n_cap, key[1]), jnp.int32),
+                jax.ShapeDtypeStruct((key[1],), jnp.int32),
+                jax.tree_util.tree_map(sds, res.ell),
+                sds(res.edge_src),
+                sds(res.edge_dst),
+                sds(res.edge_metric),
+                sds(res.edge_up),
+                sds(res.node_overloaded),
+                sds(res.out_slot),
+            )
+            self._program(key, args)
+            warmed += 1
+        return warmed
+
     # -- program cache ------------------------------------------------------
 
     def cached_program_keys(self) -> list[tuple]:

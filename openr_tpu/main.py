@@ -78,6 +78,15 @@ def _sched_counters():
     return SCHED_COUNTERS
 
 
+def _snapshot_counters():
+    """The engine-snapshot registry (snapshot.*, pre-seeded zeros).
+    Same contract as _fuzz_counters: a daemon that never takes or
+    restores a snapshot still answers the whole family on both wires."""
+    from .snapshot import SNAPSHOT_COUNTERS
+
+    return SNAPSHOT_COUNTERS
+
+
 class OpenrDaemon:
     def __init__(
         self,
@@ -406,6 +415,9 @@ class OpenrDaemon:
             # trace-span surface (obs.*, zeroed when OPENR_TRACE is off):
             # same wire shape armed or not, plus dumpTraces/getSpanSamples
             obs=_obs_stats(),
+            # engine-snapshot counters (snapshot.*, pre-seeded zeros at
+            # module import): takes/restores/replays visible on both wires
+            snapshot=_snapshot_counters(),
             kvstore_updates_queue=self.kvstore_updates_queue,
             fib_updates_queue=self.fib_updates_queue,
             config_store=self.config_store,
@@ -561,28 +573,45 @@ class ServingFleet:
 
         if k < 1:
             raise ValueError("ServingFleet needs at least one replica")
-        make = config_fn or fleet_node_config
+        self._make = config_fn or fleet_node_config
+        self._node_prefix = node_prefix
+        self._spf_backend = spf_backend
+        self._use_device_spf = use_device_spf
         self.spark_fabric = MockIoProvider()
         self.kv_fabric = InProcessTransport()
         self.daemons: list[OpenrDaemon] = []
         self._names: list[str] = []
-        for i in range(k):
-            name = f"{node_prefix}-{i}"
-            addr = f"fe80::{name}"
-            daemon = OpenrDaemon(
-                make(name),
-                io_provider=self.spark_fabric.endpoint(name),
-                kvstore_transport=self.kv_fabric.bind(addr),
-                spark_v6_addr=addr,
-                spf_backend=spf_backend,
-                use_device_spf=use_device_spf,
-            )
-            self.kv_fabric.register(addr, daemon.kvstore)
-            self.daemons.append(daemon)
-            self._names.append(name)
+        # creation index per live daemon: interface names (if-{i}-{j}) and
+        # mock addresses are minted from it and never reused, so a
+        # scale-in followed by a scale-out can't collide with the fabric
+        # state the departed replica left behind
+        self._indices: list[int] = []
+        self._next_idx = 0
+        for _ in range(k):
+            self._new_daemon()
         self._hedge_after_s = hedge_after_s
         self.router = None  # serving.ReplicaRouter (built in start())
         self.handler = None  # front-door OpenrCtrlHandler over the router
+
+    def _new_daemon(self) -> "OpenrDaemon":
+        """Mint the next replica (not yet started or meshed)."""
+        i = self._next_idx
+        self._next_idx += 1
+        name = f"{self._node_prefix}-{i}"
+        addr = f"fe80::{name}"
+        daemon = OpenrDaemon(
+            self._make(name),
+            io_provider=self.spark_fabric.endpoint(name),
+            kvstore_transport=self.kv_fabric.bind(addr),
+            spark_v6_addr=addr,
+            spf_backend=self._spf_backend,
+            use_device_spf=self._use_device_spf,
+        )
+        self.kv_fabric.register(addr, daemon.kvstore)
+        self.daemons.append(daemon)
+        self._names.append(name)
+        self._indices.append(i)
+        return daemon
 
     def start(self) -> None:
         from .serving import ReplicaRouter, SchedulerReplica
@@ -632,6 +661,7 @@ class ServingFleet:
             serving=self.router,
             sched=_sched_counters(),
             obs=_obs_stats(),
+            snapshot=_snapshot_counters(),
             queues=front._queues,
         )
 
@@ -658,6 +688,123 @@ class ServingFleet:
                     return True
             time.sleep(0.05)
         return False
+
+    # -- elastic membership (docs/ARCHITECTURE.md "Engine snapshots &
+    # elastic scale-out") --------------------------------------------------
+
+    def scale(self, k_new: int) -> list:
+        """Elastic membership under live load: grow or shrink the fleet
+        to `k_new` replicas one step at a time.  Scale-out replicas are
+        snapshot-warm-started from daemon 0's device engine before they
+        join the router, so their first routed query finds residency and
+        prewarmed programs instead of a cold build; scale-in folds the
+        departed replica's final counters into the router's roll-up so
+        the fleet wire surface stays monotone.  Returns the restore mode
+        ("replay"/"install"/"cold"/None) per scale-out step."""
+        if k_new < 1:
+            raise ValueError("ServingFleet cannot scale below one replica")
+        if self.router is None:
+            raise RuntimeError("scale() requires a started fleet")
+        modes: list = []
+        while len(self.daemons) > k_new:
+            self._scale_in()
+        while len(self.daemons) < k_new:
+            modes.append(self._scale_out())
+        return modes
+
+    def autoscale_step(self, policy) -> "object":
+        """One autoscaling observation: feed the router's fleet counter
+        roll-up plus the deepest replica admission queue to the policy
+        (snapshot.AutoscalePolicy) and apply its decision through
+        scale().  Returns the AutoscaleDecision."""
+        k = len(self.daemons)
+        depth = max(
+            (d.serving.admission.size() for d in self.daemons), default=0
+        )
+        decision = policy.observe(
+            k, self.router.get_counters(), admission_depth=depth
+        )
+        if decision.action != "hold" and decision.target_k != k:
+            self.scale(decision.target_k)
+        return decision
+
+    def _scale_out(self):
+        from .serving import SchedulerReplica
+        from .snapshot import SNAPSHOT_COUNTERS
+        from .types import LinkEvent
+
+        donor = self.daemons[0]
+        peers = list(zip(self._indices, self._names, self.daemons))
+        daemon = self._new_daemon()
+        idx = self._indices[-1]
+        name = self._names[-1]
+        daemon.start()
+        # mesh the joiner with every live peer, then announce the links
+        # on both sides (same choreography as start(), minted indices)
+        for j, jname, _ in peers:
+            self.spark_fabric.connect(
+                jname, f"if-{j}-{idx}", name, f"if-{idx}-{j}"
+            )
+        for j, jname, peer in peers:
+            peer.netlink_events_queue.push(
+                LinkEvent(f"if-{j}-{idx}", idx + 1, True)
+            )
+            daemon.netlink_events_queue.push(
+                LinkEvent(f"if-{idx}-{j}", j + 1, True)
+            )
+        self.wait_converged()
+        mode = self._warm_start(donor, daemon)
+        # join the router last: the first routed query already finds the
+        # restored residency and prewarmed programs
+        self.router.add_replica(SchedulerReplica(name, daemon.serving))
+        SNAPSHOT_COUNTERS._bump("snapshot.scaleouts")
+        return mode
+
+    def _scale_in(self) -> None:
+        from .snapshot import SNAPSHOT_COUNTERS
+
+        if len(self.daemons) <= 1:
+            raise ValueError("ServingFleet cannot scale below one replica")
+        # always retire the youngest replica: daemon 0 owns the front
+        # door handler and is the snapshot donor
+        name = self._names[-1]
+        daemon = self.daemons[-1]
+        if self.router is not None:
+            # stops new picks immediately and folds the replica's final
+            # counters into the departed roll-up before the handle dies
+            self.router.remove_replica(name)
+        daemon.stop()
+        self.daemons.pop()
+        self._names.pop()
+        self._indices.pop()
+        SNAPSHOT_COUNTERS._bump("snapshot.scaleins")
+
+    def _warm_start(self, donor: "OpenrDaemon", joiner: "OpenrDaemon"):
+        """Snapshot-restore the joiner's device engine from the donor's.
+        Converged fleets hit the content-equality install rung (the
+        joiner's fresh mirror matches the donor's structural planes);
+        drift demotes to an accounted cold build — never an error.  Hosts
+        without a device backend skip silently (None)."""
+        from .snapshot import EngineSnapshot
+
+        d_spf = getattr(donor.decision.spf_solver, "spf", None)
+        j_spf = getattr(joiner.decision.spf_solver, "spf", None)
+        if not hasattr(d_spf, "csr_mirror") or not hasattr(
+            j_spf, "csr_mirror"
+        ):
+            return None
+        d_eng = getattr(d_spf, "engine", None)
+        j_eng = getattr(j_spf, "engine", None)
+        d_ls = donor.decision.area_link_states.get("0")
+        j_ls = joiner.decision.area_link_states.get("0")
+        if None in (d_eng, j_eng, d_ls, j_ls):
+            return None
+        try:
+            snap = EngineSnapshot.take(d_eng, d_spf.csr_mirror(d_ls))
+            return snap.restore(j_eng, j_spf.csr_mirror(j_ls))
+        except Exception:  # noqa: BLE001 — warm start is best-effort
+            log.exception("snapshot warm-start failed; replica joins cold")
+            return None
 
     def stop(self) -> None:
         if self.router is not None:

@@ -209,12 +209,20 @@ class ReplicaRouter:
             _ReplicaState(h, initial_backoff_s, max_backoff_s)
             for h in replicas
         ]
+        self._initial_backoff_s = initial_backoff_s
+        self._max_backoff_s = max_backoff_s
         self.hedge_after_s = hedge_after_s
+        # auto-derived budget tracks the replica count across
+        # add_replica/remove_replica; an explicit budget is pinned
+        self._auto_max_attempts = max_attempts is None
         self.max_attempts = (
             int(max_attempts)
             if max_attempts is not None
             else max(4, 2 * len(self._replicas))
         )
+        # final counter roll-ups of replicas removed by remove_replica:
+        # the fleet's wire surface stays monotone across scale-in
+        self._departed_counters: dict[str, int] = {}
         self.default_area = default_area
         self._lock = threading.Lock()
         self.counters: dict[str, int] = {k: 0 for k in ROUTER_COUNTER_KEYS}
@@ -249,8 +257,12 @@ class ReplicaRouter:
     def get_counters(self) -> dict:
         """Fleet roll-up: summed replica scheduler counters (gauges take
         max) with the router's own `serving.router.*` family on top, so
-        one ctrl/fb303 surface exports the whole fleet."""
+        one ctrl/fb303 surface exports the whole fleet.  Departed
+        replicas' final counters stay folded in (scale-in must never
+        make the fleet surface go backwards)."""
         agg: dict[str, int] = {}
+        with self._lock:
+            agg.update(self._departed_counters)
         for rep in self._replicas:
             fn = getattr(rep.handle, "get_counters", None)
             if fn is None:
@@ -304,6 +316,73 @@ class ReplicaRouter:
     def session_pin(self, session) -> Optional[int]:
         with self._lock:
             return self._sessions.get(session)
+
+    # -- elastic membership (join/leave under live load) -----------------------
+
+    def add_replica(self, handle) -> None:
+        """Join a replica under live load.  The membership list is
+        swapped atomically under the lock (dispatch paths read it once
+        per pick), so in-flight calls keep their ledger accounting and
+        the very next pick may route to the newcomer.  Growing past one
+        replica starts the hedge monitor if hedging is configured."""
+        st = _ReplicaState(
+            handle, self._initial_backoff_s, self._max_backoff_s
+        )
+        with self._lock:
+            self._replicas = self._replicas + [st]
+            n = len(self._replicas)
+            if self._auto_max_attempts:
+                self.max_attempts = max(4, 2 * n)
+            start_hedge = (
+                self.hedge_after_s
+                and n > 1
+                and self._hedge_thread is None
+                and not self._stopped
+            )
+            if start_hedge:
+                self._hedge_thread = threading.Thread(
+                    target=self._hedge_loop,
+                    name="router-hedge",
+                    daemon=True,
+                )
+        if start_hedge:
+            self._hedge_thread.start()
+
+    def remove_replica(self, name: str):
+        """Leave under live load: the replica stops receiving new picks
+        immediately; its final counters fold into the departed roll-up
+        so the fleet surface stays monotone.  Queries already in flight
+        on it resolve through _on_reply — a handle its owner stops next
+        resolves those futures, which the router re-dispatches as
+        failovers — so the dispatch ledger still closes exactly.
+        Returns the removed handle (None when unknown)."""
+        with self._lock:
+            keep = [r for r in self._replicas if r.name != name]
+            gone = [r for r in self._replicas if r.name == name]
+            if not gone:
+                return None
+            self._replicas = keep
+            if self._auto_max_attempts:
+                self.max_attempts = max(4, 2 * max(len(keep), 1))
+        rep = gone[0]
+        fn = getattr(rep.handle, "get_counters", None)
+        final: dict = {}
+        if fn is not None:
+            try:
+                final = fn()
+            except Exception:  # noqa: BLE001 — dead at departure is fine
+                final = {}
+        with self._lock:
+            for k, v in final.items():
+                if k in _GAUGE_KEYS:
+                    self._departed_counters[k] = max(
+                        self._departed_counters.get(k, 0), int(v)
+                    )
+                else:
+                    self._departed_counters[k] = (
+                        self._departed_counters.get(k, 0) + int(v)
+                    )
+        return rep.handle
 
     # -- submission (any thread) -----------------------------------------------
 
@@ -374,8 +453,11 @@ class ReplicaRouter:
         with self._lock:
             start = self._rr
             self._rr += 1
-        n = len(self._replicas)
-        order = [self._replicas[(start + i) % n] for i in range(n)]
+            reps = self._replicas  # one read — membership swaps atomically
+        n = len(reps)
+        if n == 0:
+            return None
+        order = [reps[(start + i) % n] for i in range(n)]
         untried = [r for r in order if r.name not in call.tried]
         passes = [untried] if require_untried else [untried, order]
         for candidates in passes:

@@ -966,6 +966,75 @@ class TestCounterRegistrySweep:
         # the family round-trips the strict-binary i64 map intact
         assert all(shimmed[k] == native[k] for k in family)
 
+    def test_snapshot_family_on_both_wire_surfaces(self, daemon):
+        """The engine-snapshot ledger (checkpoints taken, restore rungs,
+        replayed events, accounted demotions, digest failures, manifest
+        prewarms, fleet scale transitions) is pre-seeded in its own
+        process-wide registry and rides _all_counters like chaos.fuzz,
+        so the whole snapshot.* family answers ONE getCounters on the
+        native ctrl server AND the fb303 shim before any snapshot is
+        ever taken — an operator can alert on replay_fallbacks or
+        digest_failures going non-zero with no warm-up query."""
+        import re
+
+        from openr_tpu.interop import thrift_binary as tb
+        from openr_tpu.interop.shim import ThriftBinaryShim
+        from openr_tpu.snapshot import SNAPSHOT_COUNTER_KEYS, SnapshotCounters
+        from test_thrift_binary import _call_ok
+
+        family = set(SNAPSHOT_COUNTER_KEYS)
+        assert {
+            "snapshot.taken",
+            "snapshot.take_us",
+            "snapshot.bytes",
+            "snapshot.restores",
+            "snapshot.restore_us",
+            "snapshot.replayed_events",
+            "snapshot.replay_fallbacks",
+            "snapshot.digest_failures",
+            "snapshot.manifest_programs",
+            "snapshot.prewarmed_programs",
+            "snapshot.scaleouts",
+            "snapshot.scaleins",
+        } == family
+        name_re = re.compile(r"[a-z][a-z0-9_]*(\.[a-z0-9_]+)+\Z")
+        assert all(name_re.match(k) for k in family)
+        # construction pre-seeds every key to zero (the process-wide
+        # singleton the daemon exports may have been bumped by an earlier
+        # in-process take/restore, so the zero contract is asserted on a
+        # fresh registry)
+        assert SnapshotCounters().get_counters() == {k: 0 for k in family}
+
+        client = CtrlClient(port=daemon.ctrl_port)
+        try:
+            native = client.call("getCounters")
+        finally:
+            client.close()
+        assert family <= set(native)
+
+        shim = ThriftBinaryShim(
+            daemon.kvstore,
+            port=0,
+            node_name="solo",
+            counters_fn=daemon.ctrl_server.handler._all_counters,
+        )
+        shim.run()
+        try:
+            shimmed = _call_ok(
+                shim.port,
+                "getCounters",
+                48,
+                b"\x00",
+                ("map", tb.T_STRING, tb.T_I64),
+                dec=lambda m: {k.decode(): v for k, v in m.items()},
+            )
+        finally:
+            shim.stop()
+            shim.wait_until_stopped(5)
+        assert family <= set(shimmed)
+        # the family round-trips the strict-binary i64 map intact
+        assert all(shimmed[k] == native[k] for k in family)
+
     def test_obs_family_on_both_wire_surfaces(self, daemon):
         """The tracing surface (ObsStats) answers the whole obs.*
         family as ZEROS on the native ctrl server AND the fb303 shim

@@ -2750,3 +2750,137 @@ class TestMplsLabelSemanticsPersistentPair:
         for nh in far.nexthops:
             assert nh.mpls_action == MplsAction(MplsActionCode.PHP)
             assert nh.mpls_action.swap_label is None
+
+
+class TestGracefulRestartPersistentPair:
+    """Ancestors: DecisionTestFixture's graceful-restart sequences
+    (DecisionTest.cpp adj-db withdraw/re-learn around node restarts and
+    the prefix re-origination counterparts).  A node restart is three
+    distinct link-state phases — withdrawal, a holddown window where the
+    *peers'* stale adjacency entries still point at the restarting node
+    (the bidirectional check is what holds them out of SPF), and a
+    partial-then-complete re-learn — and the route plane must be right,
+    on both backends, at every phase, not just after convergence."""
+
+    @staticmethod
+    def _pair():
+        host = SpfSolver("1")
+        device = SpfSolver(
+            "1",
+            spf_backend=DeviceSpfBackend(
+                min_device_nodes=1, min_device_sources=1
+            ),
+        )
+
+        def check(ls, ps, step):
+            h = host.build_route_db({"0": ls}, ps)
+            d = device.build_route_db({"0": ls}, ps)
+            assert h.unicast_routes == d.unicast_routes, step
+            assert h.mpls_routes == d.mpls_routes, step
+            return h
+
+        return check
+
+    def test_adjacency_withdraw_and_relearn_with_stale_holddown(self):
+        # node 2 restarts while 1 and 4 keep advertising their (now
+        # stale) adjacencies toward it the whole time — the holddown.
+        ls = square()
+        ps = prefix_state_with(("4", "0", PrefixEntry(prefix=PFX)))
+        check = self._pair()
+
+        db = check(ls, ps, "baseline")
+        assert nh_names(db.unicast_routes[PFX]) == {"2", "3"}
+        assert 102 in db.mpls_routes
+
+        # phase 1: restart — 2's own adj db is withdrawn.  1 and 4
+        # still hold adj("1","2") / adj("4","2"); those stale entries
+        # must not reach SPF, and 2's label must vanish with its db.
+        ls.delete_adjacency_database("2")
+        db = check(ls, ps, "restart-withdraw")
+        route = db.unicast_routes[PFX]
+        assert nh_names(route) == {"3"}
+        assert all(nh.metric == 20 for nh in route.nexthops)
+        assert 102 not in db.mpls_routes
+        assert 104 in db.mpls_routes  # 4 stays reachable via 3
+
+        # phase 2: partial re-learn — 2 comes back speaking only to 1.
+        # The stale 4-side holddown entry now has a live partner on one
+        # link only: 2 is reachable again (label returns) but traffic
+        # to 4 must still go via 3, not through the half-healed 2-4.
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="2",
+                adjacencies=[adj("2", "1")],
+                node_label=102,
+                area="0",
+            )
+        )
+        db = check(ls, ps, "partial-relearn")
+        assert nh_names(db.unicast_routes[PFX]) == {"3"}
+        near = db.mpls_routes[102]
+        assert nh_names(near) == {"2"}
+
+        # phase 3: complete re-learn — 2 republishes its full set and
+        # the pre-restart ECMP comes back bit-exact.
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="2",
+                adjacencies=[adj("2", "1"), adj("2", "4")],
+                node_label=102,
+                area="0",
+            )
+        )
+        db = check(ls, ps, "complete-relearn")
+        route = db.unicast_routes[PFX]
+        assert nh_names(route) == {"2", "3"}
+        assert all(nh.metric == 20 for nh in route.nexthops)
+        assert 102 in db.mpls_routes and 104 in db.mpls_routes
+
+    def test_prefix_reorigination_after_restart(self):
+        # the advertiser itself restarts: its prefix is withdrawn with
+        # it, the far advertiser takes over, and after the adjacency
+        # plane heals the prefix must be re-originated explicitly —
+        # adjacency recovery alone must NOT resurrect it.
+        ls = square()
+        ps = prefix_state_with(
+            ("2", "0", PrefixEntry(prefix=PFX)),
+            ("4", "0", PrefixEntry(prefix=PFX)),
+        )
+        check = self._pair()
+
+        db = check(ls, ps, "baseline")
+        route = db.unicast_routes[PFX]
+        assert nh_names(route) == {"2"}  # nearest advertiser wins
+        assert all(nh.metric == 10 for nh in route.nexthops)
+
+        # phase 1: 2 restarts — both its adj db and its origination go
+        ls.delete_adjacency_database("2")
+        ps.delete_prefix("2", "0", PFX)
+        db = check(ls, ps, "restart")
+        route = db.unicast_routes[PFX]
+        assert nh_names(route) == {"3"}
+        assert all(nh.metric == 20 for nh in route.nexthops)
+
+        # phase 2: adjacency plane heals first.  The route must stay on
+        # the far advertiser until 2 actually re-originates — no state
+        # from the pre-restart origination may leak through the restart.
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="2",
+                adjacencies=[adj("2", "1"), adj("2", "4")],
+                node_label=102,
+                area="0",
+            )
+        )
+        db = check(ls, ps, "adjacency-healed")
+        route = db.unicast_routes[PFX]
+        assert nh_names(route) == {"2", "3"}  # ECMP to advertiser 4
+        assert all(nh.metric == 20 for nh in route.nexthops)
+
+        # phase 3: re-origination — forwarding collapses back to the
+        # recovered nearest advertiser, bit-exact with the baseline.
+        ps.update_prefix("2", "0", PrefixEntry(prefix=PFX))
+        db = check(ls, ps, "reoriginate")
+        route = db.unicast_routes[PFX]
+        assert nh_names(route) == {"2"}
+        assert all(nh.metric == 10 for nh in route.nexthops)

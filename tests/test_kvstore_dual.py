@@ -141,6 +141,14 @@ class TestDualFloodTopo:
         full_mesh(stores)
         assert wait_for(lambda: all_initialized(stores))
         assert wait_for(lambda: spt_converged(stores, "a"))
+        # flood_peers narrow asynchronously after the SPT converges; a
+        # publication sent before the mesh fallback retires still fans
+        # out full-mesh (cost 4) and races the exact count below
+        assert wait_for(
+            lambda: sorted(a.get_flood_topo("0").flood_peers) == ["b", "c"]
+            and b.get_flood_topo("0").flood_peers == ["a"]
+            and c.get_flood_topo("0").flood_peers == ["a"]
+        )
 
         before = flood_pub_total(stores)
         c.set_key_vals("0", {"k": v(originator="c", value=b"fv")})

@@ -132,6 +132,71 @@ class TestReplicaFleetChaos:
         assert second.pin_violations == 0
 
 
+class TestElasticFleetChaos:
+    """Elastic-membership chaos: a snapshot-warm-started replica joins
+    mid-burst and the youngest joined replica is removed and killed under
+    load, with the scripted log (including the seed-deterministic restore
+    mode) replaying identically.  Per-replica device backends on purpose:
+    a shared backend would make the warm start vacuous."""
+
+    def _run(self, seed: int, log_: ChaosEventLog):
+        ctl = ReplicaFleetController(
+            seed=seed,
+            n=12,
+            replicas=2,
+            rounds=4,
+            clients=4,
+            per_client=5,
+            kill_round=-1,
+            restart_round=-1,
+            partition_round=-1,
+            heal_round=-1,
+            lag_rounds=(),
+            scaleout_round=1,
+            scalein_round=3,
+            spf_backend=None,
+            log_=log_,
+        )
+        return ctl, ctl.run()
+
+    @pytest.fixture(scope="class")
+    def elastic(self, cpu_burner):
+        log_ = ChaosEventLog()
+        ctl, result = self._run(_SEED, log_)
+        return ctl, result, log_
+
+    def test_membership_chaos_keeps_the_acceptance_bar(self, elastic):
+        _, result, _ = elastic
+        assert result.accounted == result.submitted
+        assert result.bit_exact
+        assert result.ledger_ok
+        assert result.pin_violations == 0
+
+    def test_scale_events_are_in_the_replay_contract(self, elastic):
+        _, _, log_ = elastic
+        steps = [
+            s
+            for entries in log_._streams.values()
+            for s in entries
+            if "fleet:scale" in str(s)
+        ]
+        # the join really warm-started (install/replay, not a cold or
+        # skipped fallback) and the scale-in removed the joined replica
+        assert any(
+            s.endswith(":install") or s.endswith(":replay") for s in steps
+        ), steps
+        assert any("fleet:scalein:replica-" in s for s in steps), steps
+
+    def test_same_seed_replays_identical_scale_log(self, elastic):
+        _, _, log1 = elastic
+        log2 = ChaosEventLog()
+        _, second = self._run(_SEED, log2)
+        assert log1.matches(log2)
+        assert second.accounted == second.submitted
+        assert second.bit_exact
+        assert second.ledger_ok
+
+
 class TestServingFleetWiring:
     """End-to-end over real daemons: main.ServingFleet brings up K full
     stacks peered over the KvStore full-mesh, and the front-door ctrl

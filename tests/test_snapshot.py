@@ -1,0 +1,338 @@
+"""Tier-1 acceptance for openr_tpu/snapshot: engine checkpoints, the
+three restore rungs, program-manifest prewarm, elastic fleet scale under
+live load, and the autoscaling policy.
+
+The acceptance bar (mirrors ISSUE/ROADMAP):
+
+- the serialized artifact roundtrips byte-identically and any corruption
+  is caught by the integrity digest at load, never at use;
+- a snapshot-restored replica answers bit-exact against its donor at the
+  pinned epoch (and against the host Dijkstra oracle);
+- staleness demotes to an accounted cold build (`snapshot.replay_fallbacks`)
+  — never an error and never a wrong answer;
+- `ServingFleet.scale(k -> k+1)` under open-loop load closes the
+  router's dispatch ledger exactly with zero silent drops.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from openr_tpu.decision.csr import CsrTopology
+from openr_tpu.device import DeviceResidencyEngine
+from openr_tpu.snapshot import (
+    SNAPSHOT_COUNTER_KEYS,
+    SNAPSHOT_COUNTERS,
+    AutoscalePolicy,
+    EngineSnapshot,
+    SnapshotFormatError,
+)
+from openr_tpu.utils.topo import grid_topology
+
+from test_link_state import build
+
+
+def _results_view(engine, csr, sources):
+    got = engine.spf_results(csr, sources)
+    return {
+        src: {
+            dest: (entry.metric, frozenset(entry.next_hops))
+            for dest, entry in res.items()
+        }
+        for src, res in got.items()
+    }
+
+
+def _oracle_view(ls, sources):
+    return {
+        src: {
+            dest: (entry.metric, frozenset(entry.next_hops))
+            for dest, entry in ls.run_spf(src).items()
+        }
+        for src in sources
+    }
+
+
+def _world(n: int = 4):
+    dbs = grid_topology(n)
+    ls = build(dbs)
+    csr = CsrTopology.from_link_state(ls)
+    return dbs, ls, csr
+
+
+class TestSerialFormat:
+    def test_roundtrip_is_byte_identical(self):
+        _, ls, csr = _world()
+        engine = DeviceResidencyEngine()
+        snap = EngineSnapshot.take(engine, csr)
+        blob = snap.to_bytes()
+        back = EngineSnapshot.from_bytes(blob)
+        assert back.to_bytes() == blob
+        assert back.epoch == snap.epoch
+        assert back.rewire_seq == snap.rewire_seq
+        assert back.topo_key == snap.topo_key
+        assert back.node_names == snap.node_names
+        assert back.manifest == snap.manifest
+        for name in snap.arrays:
+            assert np.array_equal(back.arrays[name], snap.arrays[name])
+        # lineage pins are same-process facts and never serialized
+        assert back.donor_csr_id is None and back.donor_ell_ref is None
+
+    def test_corruption_is_caught_by_the_digest(self):
+        _, ls, csr = _world()
+        engine = DeviceResidencyEngine()
+        blob = bytearray(EngineSnapshot.take(engine, csr).to_bytes())
+        before = SNAPSHOT_COUNTERS.get_counters()["snapshot.digest_failures"]
+        blob[-3] ^= 0xFF  # bit rot in the array payload
+        with pytest.raises(SnapshotFormatError, match="digest"):
+            EngineSnapshot.from_bytes(bytes(blob))
+        after = SNAPSHOT_COUNTERS.get_counters()["snapshot.digest_failures"]
+        assert after == before + 1
+
+    def test_bad_magic_and_format_skew_refuse_loudly(self):
+        _, ls, csr = _world()
+        engine = DeviceResidencyEngine()
+        blob = EngineSnapshot.take(engine, csr).to_bytes()
+        with pytest.raises(SnapshotFormatError, match="magic"):
+            EngineSnapshot.from_bytes(b"NOTASNAP" + blob[8:])
+        import json as _json
+        import struct as _struct
+
+        (hlen,) = _struct.unpack_from("<I", blob, 8)
+        header = _json.loads(blob[12 : 12 + hlen].decode())
+        header["format"] = 99
+        hdr = _json.dumps(header, sort_keys=True).encode()
+        skew = blob[:8] + _struct.pack("<I", len(hdr)) + hdr + blob[12 + hlen :]
+        with pytest.raises(SnapshotFormatError, match="format"):
+            EngineSnapshot.from_bytes(skew)
+
+
+class TestRestoreRungs:
+    def test_donor_replay_after_drift_is_bit_exact(self):
+        dbs, ls, csr = _world()
+        engine = DeviceResidencyEngine()
+        sources = ls.node_names[:3]
+        assert _results_view(engine, csr, sources) == _oracle_view(
+            ls, sources
+        )
+        snap = EngineSnapshot.take(engine, csr)
+        # attribute drift after the checkpoint: the replay rung must
+        # carry the mirror forward through the engine's own ladder
+        dbs[0].adjacencies[0].metric = 41
+        ls.update_adjacency_database(dbs[0])
+        assert csr.refresh(ls) is True
+        before = SNAPSHOT_COUNTERS.get_counters()
+        assert snap.restore(engine, csr) == "replay"
+        after = SNAPSHOT_COUNTERS.get_counters()
+        assert after["snapshot.replayed_events"] > before[
+            "snapshot.replayed_events"
+        ]
+        assert _results_view(engine, csr, sources) == _oracle_view(
+            ls, sources
+        )
+
+    def test_fresh_replica_install_is_bit_exact_at_the_pinned_epoch(self):
+        # the ISSUE acceptance: a snapshot-restored replica answers
+        # bit-exact vs its donor at the pinned epoch, without paying the
+        # donor's cold build
+        dbs, ls, csr = _world()
+        donor = DeviceResidencyEngine()
+        sources = ls.node_names[:3]
+        donor_answers = _results_view(donor, csr, sources)
+        snap = EngineSnapshot.take(donor, csr)
+        blob = snap.to_bytes()  # across the wire, pins stripped
+
+        joiner_ls = build(grid_topology(4))
+        joiner_csr = CsrTopology.from_link_state(joiner_ls)
+        joiner = DeviceResidencyEngine()
+        mode = EngineSnapshot.from_bytes(blob).restore(joiner, joiner_csr)
+        assert mode == "install"
+        assert int(joiner_csr.version) == snap.epoch
+        assert joiner.has_residency(joiner_csr)
+        assert (
+            _results_view(joiner, joiner_csr, sources) == donor_answers
+        )
+        # the warm start really skipped the cold build: installing is
+        # not a restage, and the first query found residency
+        c = joiner.get_counters()
+        assert c["device.engine.full_restages"] == 0
+
+    def test_stale_snapshot_demotes_to_accounted_cold(self):
+        dbs, ls, csr = _world()
+        donor = DeviceResidencyEngine()
+        snap = EngineSnapshot.take(donor, csr)
+
+        joiner_dbs = grid_topology(4)
+        joiner_ls = build(joiner_dbs)
+        # the joiner's truth drifted past the checkpoint: content
+        # equality must fail and the restore must demote, not mis-install
+        joiner_dbs[0].adjacencies[0].metric = 57
+        joiner_ls.update_adjacency_database(joiner_dbs[0])
+        joiner_csr = CsrTopology.from_link_state(joiner_ls)
+        joiner = DeviceResidencyEngine()
+        before = SNAPSHOT_COUNTERS.get_counters()["snapshot.replay_fallbacks"]
+        assert snap.restore(joiner, joiner_csr) == "cold"
+        after = SNAPSHOT_COUNTERS.get_counters()["snapshot.replay_fallbacks"]
+        assert after == before + 1
+        sources = joiner_ls.node_names[:2]
+        assert _results_view(joiner, joiner_csr, sources) == _oracle_view(
+            joiner_ls, sources
+        )
+
+    def test_rewire_chain_gap_demotes_inside_replay(self):
+        # run the donor mirror far past the rewire log depth after the
+        # checkpoint: the replay rung hits a chain gap inside sync() and
+        # demotes to the accounted cold build — never an error
+        dbs, ls, csr = _world()
+        engine = DeviceResidencyEngine()
+        engine.sync(csr)
+        snap = EngineSnapshot.take(engine, csr)
+        corner = dbs[0]
+        for _ in range(CsrTopology.REWIRE_LOG_DEPTH // 2 + 2):
+            gone = corner.adjacencies.pop(0)
+            ls.update_adjacency_database(corner)
+            csr.refresh(ls)
+            corner.adjacencies.insert(0, gone)
+            ls.update_adjacency_database(corner)
+            csr.refresh(ls)
+        before = SNAPSHOT_COUNTERS.get_counters()["snapshot.replay_fallbacks"]
+        assert snap.restore(engine, csr) == "cold"
+        after = SNAPSHOT_COUNTERS.get_counters()["snapshot.replay_fallbacks"]
+        assert after == before + 1
+        sources = ls.node_names[:2]
+        assert _results_view(engine, csr, sources) == _oracle_view(
+            ls, sources
+        )
+
+
+class TestPrewarm:
+    def test_manifest_prewarms_the_program_cache(self):
+        dbs, ls, csr = _world()
+        donor = DeviceResidencyEngine()
+        sources = ls.node_names[:3]
+        donor.spf_results(csr, sources)  # compile the donor's ladder key
+        snap = EngineSnapshot.take(donor, csr)
+        assert snap.manifest, "donor served queries; manifest must not be empty"
+
+        joiner_ls = build(grid_topology(4))
+        joiner_csr = CsrTopology.from_link_state(joiner_ls)
+        joiner = DeviceResidencyEngine()
+        assert snap.restore(joiner, joiner_csr) == "install"
+        c = joiner.get_counters()
+        assert c["device.engine.compiles"] == len(snap.manifest)
+        assert set(joiner.cached_program_keys()) == set(snap.manifest)
+        # the first real query rides the prewarmed program: no compile
+        joiner.spf_results(joiner_csr, sources)
+        assert (
+            joiner.get_counters()["device.engine.compiles"]
+            == c["device.engine.compiles"]
+        )
+
+
+class TestFleetScaleUnderLoad:
+    def test_scale_out_and_in_closes_the_ledger_exactly(self, cpu_burner):
+        from openr_tpu.main import ServingFleet
+        from openr_tpu.serving.router import dispatch_ledger_closes
+
+        fleet = ServingFleet(2, hedge_after_s=None)
+        fleet.start()
+        try:
+            assert fleet.wait_converged(30), "fleet never converged"
+            c0 = SNAPSHOT_COUNTERS.get_counters()
+            stop = threading.Event()
+            acct = {"submitted": 0, "resolved": 0}
+            errors: list = []
+
+            def load() -> None:
+                while not stop.is_set():
+                    fut = fleet.router.submit("paths", sources=("fleet-0",))
+                    acct["submitted"] += 1
+                    try:
+                        fut.result(timeout=10)
+                    except Exception as exc:  # noqa: BLE001 — accounted
+                        errors.append(repr(exc))
+                    acct["resolved"] += 1
+                    time.sleep(0.002)
+
+            t = threading.Thread(target=load, name="scale-load")
+            t.start()
+            time.sleep(0.3)
+            modes = fleet.scale(3)
+            # the joiner warm-started off daemon 0's snapshot: the
+            # converged fleet hits the content-equality install rung
+            assert modes == ["install"], modes
+            assert len(fleet.daemons) == 3
+            time.sleep(0.3)
+            fleet.scale(2)
+            assert len(fleet.daemons) == 2
+            time.sleep(0.3)
+            stop.set()
+            t.join()
+        finally:
+            fleet.stop()
+        # stop() joined every scheduler executor, so the ledger is final
+        counters = fleet.router.get_counters()
+        assert not errors, errors[:3]
+        assert acct["resolved"] == acct["submitted"], "silent drops"
+        assert dispatch_ledger_closes(counters, acct["submitted"]), counters
+        c1 = SNAPSHOT_COUNTERS.get_counters()
+        assert c1["snapshot.scaleouts"] == c0["snapshot.scaleouts"] + 1
+        assert c1["snapshot.scaleins"] == c0["snapshot.scaleins"] + 1
+        assert c1["snapshot.taken"] == c0["snapshot.taken"] + 1
+
+
+class TestAutoscalePolicy:
+    def test_shed_pressure_scales_out_then_cools_down(self):
+        p = AutoscalePolicy(max_replicas=4, cooldown=2)
+        assert p.observe(1, {"serving.router.sheds": 0}).action == "hold"
+        d = p.observe(1, {"serving.router.sheds": 3})
+        assert (d.action, d.target_k) == ("scale_out", 2)
+        # cooldown: even under continued pressure the policy holds
+        assert p.observe(2, {"serving.router.sheds": 6}).reason == "cooldown"
+        assert p.observe(2, {"serving.router.sheds": 9}).reason == "cooldown"
+        d = p.observe(2, {"serving.router.sheds": 12})
+        assert (d.action, d.target_k) == ("scale_out", 3)
+
+    def test_admission_depth_is_a_scale_out_signal(self):
+        p = AutoscalePolicy(depth_high=10, cooldown=0)
+        d = p.observe(1, {}, admission_depth=64)
+        assert d.action == "scale_out"
+        assert "admission_depth" in d.reason
+
+    def test_max_replicas_clamps(self):
+        p = AutoscalePolicy(max_replicas=2, cooldown=0)
+        d = p.observe(2, {"serving.router.sheds": 5})
+        assert (d.action, d.reason) == ("hold", "at max_replicas")
+
+    def test_idle_streak_scales_in_but_never_below_min(self):
+        p = AutoscalePolicy(min_replicas=1, idle_intervals=3, cooldown=0)
+        assert p.observe(2, {}).action == "hold"
+        assert p.observe(2, {}).action == "hold"
+        d = p.observe(2, {})
+        assert (d.action, d.target_k) == ("scale_in", 1)
+        # at the floor: three more idle ticks, still no scale-in
+        for _ in range(2):
+            assert p.observe(1, {}).action == "hold"
+        assert p.observe(1, {}).reason == "at min_replicas"
+
+    def test_traffic_resets_the_idle_streak(self):
+        p = AutoscalePolicy(idle_intervals=2, cooldown=0)
+        assert p.observe(2, {"serving.router.dispatches": 0}).action == "hold"
+        # a busy tick resets the streak
+        assert (
+            p.observe(2, {"serving.router.dispatches": 50}).reason == "steady"
+        )
+        assert p.observe(2, {"serving.router.dispatches": 50}).action == "hold"
+        d = p.observe(2, {"serving.router.dispatches": 50})
+        assert d.action == "scale_in"
+
+
+class TestCounterRegistry:
+    def test_family_is_pre_seeded_and_registry_shaped(self):
+        c = SNAPSHOT_COUNTERS.get_counters()
+        assert set(SNAPSHOT_COUNTER_KEYS) <= set(c)
+        assert all(k.startswith("snapshot.") for k in c)
